@@ -1,0 +1,347 @@
+"""Process-level runtime state and the ``hvd.*`` basics API.
+
+TPU-native replacement for the reference's ``HorovodBasics`` ctypes bridge
+(reference: common/basics.py:22-258 backed by the extern "C" query API in
+operations.cc:708-896).  Instead of loading a compiled shared library per
+framework, horovod_tpu keeps one process-wide runtime whose data plane is
+XLA; the optional C++ core accelerates the control plane only.
+
+Topology model (TPU-first):
+  * a *rank* is a launched process (one per TPU-VM host, or one per chip
+    when the launcher splits hosts into per-chip slots);
+  * each rank owns ``jax.local_devices()`` chips;
+  * device-level parallelism inside a rank is expressed through the mesh
+    (``horovod_tpu.parallel``), compiled by XLA — not by more processes.
+"""
+
+import atexit
+import logging
+import os
+import threading
+from typing import List, Optional, Sequence
+
+from . import env as env_mod
+from .env import Knobs, RankInfo
+from .exceptions import NotInitializedError
+
+logger = logging.getLogger("horovod_tpu")
+
+# Reduction op constants, matching the reference's enum values
+# (reference: common/basics.py Average/Sum/Adasum constants + common.h).
+Average = "Average"
+Sum = "Sum"
+Adasum = "Adasum"
+Min = "Min"
+Max = "Max"
+Product = "Product"
+
+
+class ProcessSet:
+    """A subset of ranks forming their own collective group.
+
+    The analog of ``hvd.init(comm=[ranks])`` sub-communicators
+    (reference: common/basics.py:33-65, controller.h:112-117).  The global
+    process set contains every rank.
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = (
+            sorted(ranks) if ranks is not None else None)
+        self.process_set_id: int = 0 if ranks is None else -1
+
+    def included(self, rank: int) -> bool:
+        return self.ranks is None or rank in self.ranks
+
+    def size(self) -> int:
+        state = _state()
+        return (state.rank_info.size if self.ranks is None
+                else len(self.ranks))
+
+    def rank(self) -> int:
+        state = _state()
+        if self.ranks is None:
+            return state.rank_info.rank
+        return self.ranks.index(state.rank_info.rank)
+
+    def __repr__(self):
+        return f"ProcessSet(ranks={self.ranks or 'global'})"
+
+
+global_process_set = ProcessSet(None)
+
+
+class HorovodTpuState:
+    """Per-process singleton (analog of HorovodGlobalState,
+    reference: common/global_state.h:43-132)."""
+
+    def __init__(self):
+        self.initialized = False
+        self.init_lock = threading.Lock()
+        self.rank_info = RankInfo()
+        self.knobs = Knobs()
+        self.process_sets: List[ProcessSet] = [global_process_set]
+        self.backend = None          # ops data-plane backend
+        self.runtime = None          # background negotiation runtime
+        self.timeline = None
+        self.parameter_manager = None
+        self.elastic_enabled = False
+        self.host_messages = None    # elastic host-update queue
+        self.is_homogeneous = True
+        self.distributed_client_owned = False
+
+    def require_init(self):
+        if not self.initialized:
+            raise NotInitializedError()
+
+
+_global_state = HorovodTpuState()
+
+
+def _state() -> HorovodTpuState:
+    return _global_state
+
+
+def _maybe_init_jax_distributed(info: RankInfo):
+    """Join the multi-controller JAX world when launched with size > 1.
+
+    On TPU pods this wires the coordination service over DCN (the analog
+    of the reference's rendezvous in gloo/gloo_context.cc:63-84, except
+    the bulk data plane then rides compiled ICI collectives).  On CPU the
+    gloo cross-process collective implementation is selected so the same
+    code path is testable without TPU hardware.
+    """
+    import jax
+
+    coordinator = os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR)
+    if coordinator is None:
+        return False
+    if jax.process_count() > 1:
+        return False  # already initialized by the platform
+    try:
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
+    except Exception:
+        already = False
+    if already:
+        return False
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
+            os.environ.get("HOROVOD_TPU_FORCE_CPU"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=info.size,
+        process_id=info.rank)
+    return True
+
+
+def init(comm=None, process_sets=None):
+    """Initialize horovod_tpu.
+
+    ``comm`` may be a list of ranks forming a sub-world (reference
+    semantics of ``hvd.init(comm=[0,1])``, common/basics.py:33-65); mpi4py
+    communicators are not supported (no MPI on TPU pods) — the rendezvous
+    is the launcher env contract / TPU slice metadata instead.
+    """
+    state = _state()
+    with state.init_lock:
+        if state.initialized:
+            return
+        state.rank_info = RankInfo.from_env()
+        state.knobs = Knobs.from_env()
+
+        if comm is not None and not hasattr(comm, "Get_rank"):
+            ranks = sorted(comm)
+            if state.rank_info.launched and ranks:
+                # Restrict the world to the given ranks.
+                if state.rank_info.rank in ranks:
+                    sub_rank = ranks.index(state.rank_info.rank)
+                    state.rank_info.rank = sub_rank
+                    state.rank_info.size = len(ranks)
+
+        if state.rank_info.size > 1:
+            state.distributed_client_owned = _maybe_init_jax_distributed(
+                state.rank_info)
+
+        from ..ops.backend import create_backend
+        state.backend = create_backend(state)
+
+        from .runtime import BackgroundRuntime
+        state.runtime = BackgroundRuntime(state)
+        state.runtime.start()
+
+        if state.knobs.timeline:
+            from .timeline import Timeline
+            state.timeline = Timeline(state.knobs.timeline,
+                                      rank=state.rank_info.rank)
+            state.runtime.timeline = state.timeline
+
+        if process_sets:
+            for ps in process_sets:
+                add_process_set(ps)
+
+        state.initialized = True
+        logger.debug("horovod_tpu initialized: rank=%d size=%d local=%d/%d",
+                     state.rank_info.rank, state.rank_info.size,
+                     state.rank_info.local_rank, state.rank_info.local_size)
+
+
+def shutdown():
+    state = _state()
+    with state.init_lock:
+        if not state.initialized:
+            return
+        if state.runtime is not None:
+            state.runtime.stop()
+            state.runtime = None
+        if state.timeline is not None:
+            state.timeline.close()
+            state.timeline = None
+        state.backend = None
+        state.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _state().initialized
+
+
+def rank() -> int:
+    state = _state()
+    state.require_init()
+    return state.rank_info.rank
+
+
+def size() -> int:
+    state = _state()
+    state.require_init()
+    return state.rank_info.size
+
+
+def local_rank() -> int:
+    state = _state()
+    state.require_init()
+    return state.rank_info.local_rank
+
+
+def local_size() -> int:
+    state = _state()
+    state.require_init()
+    return state.rank_info.local_size
+
+
+def cross_rank() -> int:
+    state = _state()
+    state.require_init()
+    return state.rank_info.cross_rank
+
+
+def cross_size() -> int:
+    state = _state()
+    state.require_init()
+    return state.rank_info.cross_size
+
+
+def num_chips() -> int:
+    """Total accelerator chips across the world (TPU-specific addition):
+    size() counts processes; this counts devices."""
+    import jax
+    _state().require_init()
+    return jax.device_count()
+
+
+def local_chips() -> int:
+    import jax
+    _state().require_init()
+    return jax.local_device_count()
+
+
+def is_homogeneous() -> bool:
+    state = _state()
+    state.require_init()
+    return state.is_homogeneous
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    # The TCP control plane is the gloo analog and is always available.
+    return True
+
+
+def gloo_enabled() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    return True
+
+
+def xla_enabled() -> bool:
+    return True
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False):
+    """Start timeline recording at runtime (reference:
+    horovod_start_timeline, operations.cc:738-764)."""
+    state = _state()
+    state.require_init()
+    from .timeline import Timeline
+    if state.timeline is not None:
+        state.timeline.close()
+    state.timeline = Timeline(file_path, rank=state.rank_info.rank,
+                              mark_cycles=mark_cycles)
+    if state.runtime is not None:
+        state.runtime.timeline = state.timeline
+
+
+def stop_timeline():
+    state = _state()
+    state.require_init()
+    if state.timeline is not None:
+        state.timeline.close()
+        state.timeline = None
+    if state.runtime is not None:
+        state.runtime.timeline = None
+
+
+def add_process_set(ranks) -> ProcessSet:
+    state = _state()
+    ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
+    ps.process_set_id = len(state.process_sets)
+    state.process_sets.append(ps)
+    return ps
+
+
+def remove_process_set(ps: ProcessSet):
+    state = _state()
+    if ps in state.process_sets and ps.process_set_id != 0:
+        state.process_sets.remove(ps)

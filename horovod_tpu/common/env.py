@@ -1,0 +1,172 @@
+"""Environment-variable knob and rank contract parsing.
+
+The single source of truth for configuration is environment variables, the
+same contract the reference core uses (reference: common/common.h:64-92,
+parsed in operations.cc:441-534 and utils/env_parser.cc; rank identity
+contract in runner/gloo_run.py:65-76).  The launcher translates CLI flags /
+YAML config into these variables and forwards them to every slot; the
+in-process runtime reads them once at ``init()``.
+"""
+
+import dataclasses
+import os
+from typing import Optional
+
+# --- rank identity contract (set by the launcher for every slot) ---------
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+
+# --- rendezvous / control plane ------------------------------------------
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+HOROVOD_IFACE = "HOROVOD_GLOO_IFACE"
+# Elastic workers ask the rendezvous server for a fresh rank assignment
+# using this scope key (reference: gloo/gloo_context.cc:154-200).
+GET_RANK_AND_SIZE = "rank_and_size"
+
+# --- performance knobs ----------------------------------------------------
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+
+# --- observability --------------------------------------------------------
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+
+# --- elastic --------------------------------------------------------------
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_HOSTNAME_KEY = HOROVOD_HOSTNAME
+
+# --- TPU-specific ---------------------------------------------------------
+HOROVOD_TPU_OPERATIONS = "HOROVOD_TPU_OPERATIONS"   # "XLA" (default) | "TCP"
+HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"     # e.g. "dp:4,tp:2"
+HOROVOD_TPU_COORDINATOR = "HOROVOD_TPU_COORDINATOR"  # jax.distributed addr
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in _TRUE
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class RankInfo:
+    """The launcher → worker rank contract, or single-process defaults."""
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+    @classmethod
+    def from_env(cls) -> "RankInfo":
+        return cls(
+            rank=env_int(HOROVOD_RANK, 0),
+            size=env_int(HOROVOD_SIZE, 1),
+            local_rank=env_int(HOROVOD_LOCAL_RANK, 0),
+            local_size=env_int(HOROVOD_LOCAL_SIZE, 1),
+            cross_rank=env_int(HOROVOD_CROSS_RANK, 0),
+            cross_size=env_int(HOROVOD_CROSS_SIZE, 1),
+        )
+
+    @property
+    def launched(self) -> bool:
+        """True when a launcher provided the contract (vs. bare script)."""
+        return HOROVOD_RANK in os.environ
+
+
+@dataclasses.dataclass
+class Knobs:
+    """Runtime tunables, parsed once at init.
+
+    Defaults mirror the reference core's (operations.cc:441-534): 64 MB
+    fusion threshold, 1 ms cycle time, 1024-entry response cache.  The
+    autotuner may override fusion_threshold_bytes / cycle_time_ms at
+    runtime (parameter manager).
+    """
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+    timeline: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    stall_check_disable: bool = False
+    stall_warning_time_s: float = 60.0
+    stall_shutdown_time_s: float = 0.0
+    elastic: bool = False
+    tpu_operations: str = "XLA"
+
+    @classmethod
+    def from_env(cls) -> "Knobs":
+        return cls(
+            fusion_threshold_bytes=env_int(
+                HOROVOD_FUSION_THRESHOLD, 64 * 1024 * 1024),
+            cycle_time_ms=env_float(HOROVOD_CYCLE_TIME, 1.0),
+            cache_capacity=env_int(HOROVOD_CACHE_CAPACITY, 1024),
+            hierarchical_allreduce=env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            autotune=env_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG),
+            autotune_warmup_samples=env_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steps_per_sample=env_int(
+                HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+            autotune_bayes_opt_max_samples=env_int(
+                HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20),
+            autotune_gaussian_process_noise=env_float(
+                HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8),
+            timeline=os.environ.get(HOROVOD_TIMELINE),
+            timeline_mark_cycles=env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            stall_check_disable=env_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_warning_time_s=env_float(
+                HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0),
+            stall_shutdown_time_s=env_float(
+                HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
+            elastic=env_bool(HOROVOD_ELASTIC),
+            tpu_operations=os.environ.get(HOROVOD_TPU_OPERATIONS, "XLA"),
+        )

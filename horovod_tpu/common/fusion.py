@@ -1,0 +1,94 @@
+"""Fusion planning: batch matched responses into fused collectives.
+
+The reference fuses same-type/same-dtype responses into one buffer up to
+HOROVOD_FUSION_THRESHOLD bytes, with a look-ahead skip so one mismatched
+dtype doesn't break a fusable run (reference: controller.cc:777-914,
+FuseResponses, look-ahead at :826-848; threshold rounding for
+hierarchical ops at :451-469).
+
+On TPU the fused batch becomes ONE compiled XLA program (concat →
+collective → split happen on-device in HBM, fused by XLA), so the fusion
+plan doubles as the executable-cache key: stable plans mean compile-cache
+hits — which is why deterministic ordering matters even more here than in
+the reference (SURVEY §7 hard parts).
+"""
+
+from typing import List
+
+from .message import Response, ResponseType, dtype_size
+
+
+_FUSABLE = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
+            ResponseType.ALLGATHER, ResponseType.REDUCESCATTER}
+
+
+def response_bytes(resp: Response, entry_sizes) -> int:
+    """Total payload bytes of a response given per-tensor element counts."""
+    total = 0
+    for name in resp.tensor_names:
+        total += entry_sizes[name] * dtype_size(resp.tensor_type)
+    return total
+
+
+def _can_fuse(a: Response, b: Response) -> bool:
+    if a.response_type != b.response_type:
+        return False
+    if a.response_type not in _FUSABLE:
+        return False
+    return (a.tensor_type == b.tensor_type
+            and a.process_set_id == b.process_set_id
+            and a.prescale_factor == b.prescale_factor
+            and a.postscale_factor == b.postscale_factor
+            and a.reduce_op == b.reduce_op)
+
+
+def fuse_responses(responses: List[Response], entry_sizes,
+                   threshold_bytes: int) -> List[Response]:
+    """Greedy fusion with look-ahead skip.
+
+    ``entry_sizes`` maps tensor name → element count.  Responses that
+    cannot fuse (broadcast, alltoall, errors, joins) pass through
+    unchanged, preserving overall order determinism so every rank builds
+    the identical plan.
+    """
+    out: List[Response] = []
+    queue = list(responses)
+    while queue:
+        base = queue.pop(0)
+        if base.response_type not in _FUSABLE:
+            out.append(base)
+            continue
+        acc_bytes = response_bytes(base, entry_sizes)
+        fused = base
+        skipped: List[Response] = []
+        i = 0
+        while i < len(queue):
+            cand = queue[i]
+            if _can_fuse(fused, cand):
+                cand_bytes = response_bytes(cand, entry_sizes)
+                if acc_bytes + cand_bytes <= threshold_bytes:
+                    fused = Response(
+                        response_type=fused.response_type,
+                        tensor_names=fused.tensor_names + cand.tensor_names,
+                        tensor_type=fused.tensor_type,
+                        devices=fused.devices,
+                        tensor_sizes=fused.tensor_sizes + cand.tensor_sizes,
+                        prescale_factor=fused.prescale_factor,
+                        postscale_factor=fused.postscale_factor,
+                        process_set_id=fused.process_set_id,
+                        reduce_op=fused.reduce_op,
+                        root_rank=fused.root_rank,
+                    )
+                    acc_bytes += cand_bytes
+                    queue.pop(i)
+                    continue
+                else:
+                    # Full — stop scanning, keep remaining order intact.
+                    break
+            else:
+                # Look-ahead skip (reference controller.cc:826-848): a
+                # response of a different dtype/type does not terminate
+                # the scan; keep looking for fusable candidates behind it.
+                i += 1
+        out.append(fused)
+    return out

@@ -1,0 +1,68 @@
+"""Stall inspector: detect ranks that fail to submit matching tensors.
+
+Mirrors the reference stall inspector (reference: stall_inspector.{h,cc}:
+rank-0 warns when some ranks submitted a tensor and others have not for
+>60 s (:74-80), optionally shuts down after
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, and invalidates stalled cached
+tensors so they renegotiate).
+"""
+
+import logging
+import time
+from typing import Dict, List, Set, Tuple
+
+logger = logging.getLogger("horovod_tpu.stall")
+
+
+class StallInspector:
+    def __init__(self, warning_time_s: float = 60.0,
+                 shutdown_time_s: float = 0.0, world_size: int = 1):
+        self.warning_time_s = warning_time_s
+        self.shutdown_time_s = shutdown_time_s
+        self.world_size = world_size
+        # tensor name -> (first seen ts, set of ranks that reported)
+        self._uncompleted: Dict[str, Tuple[float, Set[int]]] = {}
+        self._warned: Set[str] = set()
+
+    def record_uncached_tensor(self, name: str, rank: int):
+        now = time.monotonic()
+        ts, ranks = self._uncompleted.get(name, (now, set()))
+        ranks.add(rank)
+        self._uncompleted[name] = (ts, ranks)
+
+    def record_cached_tensor(self, name: str):
+        # Cached tensors bypass negotiation; still track timestamps so a
+        # rank that stops submitting a cached tensor is caught.
+        self.record_uncached_tensor(name, -1)
+
+    def remove(self, name: str):
+        self._uncompleted.pop(name, None)
+        self._warned.discard(name)
+
+    def check(self) -> List[str]:
+        """Returns tensor names to invalidate from the response cache;
+        logs warnings; raises on shutdown threshold."""
+        now = time.monotonic()
+        invalidate = []
+        stalled_msgs = []
+        for name, (ts, ranks) in self._uncompleted.items():
+            age = now - ts
+            if age > self.warning_time_s and name not in self._warned:
+                missing = sorted(set(range(self.world_size)) -
+                                 {r for r in ranks if r >= 0})
+                stalled_msgs.append(
+                    f"{name} [ready: {sorted(r for r in ranks if r >= 0)}, "
+                    f"waiting: {missing}]")
+                self._warned.add(name)
+                invalidate.append(name)
+            if self.shutdown_time_s > 0 and age > self.shutdown_time_s:
+                raise RuntimeError(
+                    f"Stalled tensor {name!r} exceeded shutdown threshold "
+                    f"({self.shutdown_time_s}s); aborting (set "
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=0 to disable).")
+        if stalled_msgs:
+            logger.warning(
+                "One or more tensors were submitted to be reduced/gathered "
+                "but some ranks have not yet submitted them. Stalled ops: %s",
+                "; ".join(stalled_msgs))
+        return invalidate

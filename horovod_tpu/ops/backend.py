@@ -1,0 +1,125 @@
+"""Data-plane backends executing fused collective batches.
+
+The analog of the reference's OperationManager + per-backend op classes
+(reference: ops/operation_manager.{h,cc} priority dispatch;
+ops/nccl_operations.cc, ops/mpi_operations.cc, ops/gloo_operations.cc).
+On TPU there are two planes:
+
+* ``SingleProcessBackend`` — size-1 world (the degenerate case, also the
+  path used when one process drives an entire slice and all device-level
+  parallelism happens in-graph through ``horovod_tpu.parallel``);
+* ``XlaMeshBackend`` (xla_ops.py) — multi-process world over a global
+  JAX mesh: the fused batch compiles to one XLA program whose collectives
+  ride ICI/DCN.
+
+Backend selection mirrors HOROVOD_CPU_OPERATIONS / HOROVOD_CONTROLLER
+(reference: utils/env_parser.cc) via HOROVOD_TPU_OPERATIONS.
+"""
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+
+def _is_jax(x) -> bool:
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def _scale(x, factor: float):
+    if factor == 1.0:
+        return x
+    if _is_jax(x):
+        import jax.numpy as jnp
+        return (x * jnp.asarray(factor, dtype=x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else (x * factor).astype(x.dtype))
+    x = np.asarray(x)
+    if np.issubdtype(x.dtype, np.floating):
+        return x * np.asarray(factor, dtype=x.dtype)
+    return (x * factor).astype(x.dtype)
+
+
+class Backend:
+    name = "abstract"
+
+    def world_size(self, process_set_id: int = 0) -> int:
+        raise NotImplementedError
+
+    def allreduce(self, arrays: List[Any], reduce_op: str, prescale: float,
+                  postscale: float, process_set_id: int) -> List[Any]:
+        raise NotImplementedError
+
+    def adasum_allreduce(self, arrays, prescale, postscale,
+                         process_set_id) -> List[Any]:
+        raise NotImplementedError
+
+    def allgather(self, arrays: List[Any], sizes: List[int],
+                  process_set_id: int) -> List[Any]:
+        raise NotImplementedError
+
+    def broadcast(self, arrays: List[Any], root_rank: int,
+                  process_set_id: int) -> List[Any]:
+        raise NotImplementedError
+
+    def alltoall(self, array, splits, process_set_id: int
+                 ) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+    def reducescatter(self, arrays: List[Any], reduce_op: str,
+                      process_set_id: int) -> List[Any]:
+        raise NotImplementedError
+
+    def barrier(self, process_set_id: int = 0):
+        raise NotImplementedError
+
+
+class SingleProcessBackend(Backend):
+    """World of one rank: collectives are (scaled) identities.
+
+    Matches reference behavior when running without a launcher — e.g.
+    `python train.py` directly gives size()==1 and allreduce returns its
+    input (times pre/post scale).
+    """
+    name = "single"
+
+    def world_size(self, process_set_id: int = 0) -> int:
+        return 1
+
+    def allreduce(self, arrays, reduce_op, prescale, postscale,
+                  process_set_id):
+        out = []
+        for x in arrays:
+            y = _scale(x, prescale)
+            y = _scale(y, postscale)
+            out.append(y)
+        return out
+
+    def adasum_allreduce(self, arrays, prescale, postscale, process_set_id):
+        return self.allreduce(arrays, "Adasum", prescale, postscale,
+                              process_set_id)
+
+    def allgather(self, arrays, sizes, process_set_id):
+        return list(arrays)
+
+    def broadcast(self, arrays, root_rank, process_set_id):
+        return list(arrays)
+
+    def alltoall(self, array, splits, process_set_id):
+        if splits is None:
+            return array, None
+        recv_splits = np.asarray(splits)
+        return array, recv_splits
+
+    def reducescatter(self, arrays, reduce_op, process_set_id):
+        return list(arrays)
+
+    def barrier(self, process_set_id: int = 0):
+        return None
+
+
+def create_backend(state) -> Backend:
+    if state.rank_info.size == 1:
+        return SingleProcessBackend()
+    from .xla_ops import XlaMeshBackend
+    return XlaMeshBackend(state)

@@ -1,0 +1,40 @@
+"""Test configuration: force the CPU platform with 8 virtual devices.
+
+Mirrors the reference's test strategy of standing in multi-process
+localhost runs for real clusters (SURVEY §4): here an 8-device virtual
+CPU mesh stands in for a TPU slice for in-graph collective tests, and
+subprocess workers stand in for multi-host runs for control-plane tests.
+
+The axon TPU plugin pins jax_platforms, so the override must go through
+jax.config (env vars alone are ignored).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["HOROVOD_TPU_FORCE_CPU"] = "1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture
+def hvd_single():
+    """Initialized single-process horovod_tpu, clean shutdown after."""
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    from horovod_tpu.parallel import build_mesh
+    return build_mesh({"dp": 8})

@@ -1,0 +1,73 @@
+"""Basics API tests (reference analog: test/single + parts of
+test_tensorflow.py rank/size checks)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import NotInitializedError
+
+
+def test_not_initialized_raises():
+    hvd.shutdown()
+    with pytest.raises(NotInitializedError):
+        hvd.rank()
+
+
+def test_init_single_process(hvd_single):
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+    assert hvd.num_chips() == 8
+    assert hvd.local_chips() == 8
+
+
+def test_init_idempotent(hvd_single):
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_built_flags(hvd_single):
+    assert hvd.xla_built() and hvd.xla_enabled()
+    assert hvd.gloo_built() and hvd.gloo_enabled()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.cuda_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_env_rank_contract(monkeypatch):
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    monkeypatch.setenv("HOROVOD_SIZE", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "0")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "1")
+    monkeypatch.setenv("HOROVOD_CROSS_RANK", "0")
+    monkeypatch.setenv("HOROVOD_CROSS_SIZE", "1")
+    hvd.init()
+    assert hvd.size() == 1
+    assert hvd.rank() == 0
+    hvd.shutdown()
+
+
+def test_process_set(hvd_single):
+    ps = hvd.add_process_set([0])
+    assert ps.included(0)
+    assert ps.size() == 1
+    assert ps.rank() == 0
+    hvd.remove_process_set(ps)
+
+
+def test_shutdown_and_reinit():
+    hvd.init()
+    assert hvd.is_initialized()
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init()
+    assert hvd.is_initialized()
+    hvd.shutdown()

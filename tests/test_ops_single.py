@@ -1,0 +1,124 @@
+"""Eager op semantics in a size-1 world (reference analog: the np=1
+degenerate cases of test/parallel/test_tensorflow.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+def test_allreduce_identity(hvd_single):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    y = hvd.allreduce(x, name="t0")
+    np.testing.assert_allclose(np.asarray(y), x)
+
+
+def test_allreduce_sum_vs_average(hvd_single):
+    x = np.ones((4,), dtype=np.float32)
+    s = hvd.allreduce(x, op=hvd.Sum, name="t1")
+    a = hvd.allreduce(x, op=hvd.Average, name="t2")
+    np.testing.assert_allclose(np.asarray(s), x)
+    np.testing.assert_allclose(np.asarray(a), x)
+
+
+def test_allreduce_jax_array(hvd_single):
+    x = jnp.arange(8.0)
+    y = hvd.allreduce(x, name="t3")
+    assert isinstance(y, type(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
+def test_allreduce_prescale_postscale(hvd_single):
+    x = np.full((4,), 2.0, dtype=np.float32)
+    y = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                      postscale_factor=3.0, name="t4")
+    np.testing.assert_allclose(np.asarray(y), x * 1.5)
+
+
+def test_allreduce_async_poll(hvd_single):
+    x = np.ones((2,), dtype=np.float32)
+    h = hvd.allreduce_async(x, name="t5")
+    out = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_grouped_allreduce(hvd_single):
+    xs = [np.full((3,), float(i), dtype=np.float32) for i in range(5)]
+    ys = hvd.grouped_allreduce(xs, name="g0")
+    assert len(ys) == 5
+    for x, y in zip(xs, ys):
+        np.testing.assert_allclose(np.asarray(y), x)
+
+
+def test_allgather_identity(hvd_single):
+    x = np.arange(6, dtype=np.int32).reshape(2, 3)
+    y = hvd.allgather(x, name="ag0")
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_broadcast_identity(hvd_single):
+    x = np.arange(4, dtype=np.float64)
+    y = hvd.broadcast(x, root_rank=0, name="b0")
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_alltoall_identity(hvd_single):
+    x = np.arange(10, dtype=np.float32)
+    y = hvd.alltoall(x, name="a2a0")
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_alltoall_with_splits(hvd_single):
+    x = np.arange(10, dtype=np.float32)
+    y, recv = hvd.alltoall(x, splits=np.array([10]), name="a2a1")
+    np.testing.assert_array_equal(np.asarray(y), x)
+    np.testing.assert_array_equal(np.asarray(recv), [10])
+
+
+def test_reducescatter_identity(hvd_single):
+    x = np.arange(8, dtype=np.float32)
+    y = hvd.reducescatter(x, name="rs0")
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_join_single(hvd_single):
+    assert hvd.join() == 0
+
+
+def test_barrier(hvd_single):
+    hvd.barrier()
+
+
+def test_duplicate_name_error(hvd_single):
+    from horovod_tpu.common.exceptions import DuplicateTensorNameError
+    import threading
+    # Block the background thread's completion path by submitting two
+    # entries with the same name before the cycle runs is racy; instead
+    # check the tensor-queue contract directly.
+    from horovod_tpu.common.tensor_queue import (TensorQueue,
+                                                 TensorTableEntry)
+    from horovod_tpu.common.message import Request, RequestType
+    q = TensorQueue()
+    e = TensorTableEntry(tensor_name="dup", tensor=None,
+                         callback=lambda ok, r: None)
+    r = Request(request_rank=0, request_type=RequestType.ALLREDUCE,
+                tensor_name="dup")
+    q.add(r, e)
+    with pytest.raises(DuplicateTensorNameError):
+        q.add(r, TensorTableEntry(tensor_name="dup", tensor=None,
+                                  callback=lambda ok, r: None))
+
+
+def test_dtypes(hvd_single):
+    for dt in (np.uint8, np.int8, np.int32, np.int64, np.float16,
+               np.float32, np.float64):
+        x = np.ones((4,), dtype=dt)
+        y = hvd.allreduce(x, op=hvd.Sum, name=f"dt.{np.dtype(dt).name}")
+        assert np.asarray(y).dtype == dt
+        np.testing.assert_array_equal(np.asarray(y), x)
+    xb = jnp.ones((4,), dtype=jnp.bfloat16)
+    yb = hvd.allreduce(xb, op=hvd.Sum, name="dt.bf16")
+    assert yb.dtype == jnp.bfloat16
