@@ -1,0 +1,109 @@
+"""Synthetic ResNet-50 training benchmark (images/sec per chip).
+
+TPU-native equivalent of the reference synthetic benchmarks
+(reference: examples/pytorch/pytorch_synthetic_benchmark.py:106-118 and
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py — metric:
+img/sec = batch_size * num_batches_per_iter / time).
+
+vs_baseline compares against the reference's published per-GPU
+throughput: ResNet-101, tf_cnn_benchmarks, 1656.82 img/sec on 16
+Pascal P100s = 103.55 img/sec/GPU (docs/benchmarks.rst:32-43) — the
+only absolute throughput number the reference publishes.
+
+Prints exactly ONE JSON line.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+REFERENCE_IMG_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:32-43
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny CPU-friendly run for CI")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--num-iters", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=5)
+    args = p.parse_args()
+
+    if args.smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import ResNet50, ResNet18
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if args.smoke:
+        model = ResNet18(num_classes=10)
+        batch_size = args.batch_size or 8
+        img = 32
+        args.num_iters = min(args.num_iters, 5)
+        args.warmup = 2
+    else:
+        model = ResNet50(num_classes=1000)
+        batch_size = args.batch_size or (128 if on_tpu else 16)
+        img = 224
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch_size, img, img, 3), dtype=jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 10 if args.smoke else 1000,
+                                     batch_size), dtype=jnp.int32)
+
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, x, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, labels):
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, x, labels)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_bs, new_opt, loss
+
+    # Warmup (includes compilation).  NOTE: a host-side scalar fetch is
+    # the only reliable execution barrier on relayed TPU backends
+    # (block_until_ready can return before remote execution finishes).
+    for _ in range(args.warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_sec = batch_size * args.num_iters / dt
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip" if not args.smoke
+                  else "resnet18_smoke_images_per_sec",
+        "value": round(img_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_sec / REFERENCE_IMG_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
+                     ResNet152)
+from .bert import (BertConfig, BertEncoder, BertForMaskedLM,
+                   bert_base_config, bert_large_config, bert_tiny_config,
+                   mlm_loss)
+from .mnist import MnistCNN, MnistMLP, cross_entropy_loss
+
+__all__ = [
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+    "ResNet152",
+    "BertConfig", "BertEncoder", "BertForMaskedLM", "bert_base_config",
+    "bert_large_config", "bert_tiny_config", "mlm_loss",
+    "MnistCNN", "MnistMLP", "cross_entropy_loss",
+]

@@ -1,0 +1,190 @@
+"""BERT encoder family in Flax, bfloat16-first.
+
+BERT-large pretraining is a reference headline workload (Adasum BERT
+target in BASELINE.md; reference: docs/adasum_user_guide.rst,
+examples/adasum/).  The reference has no model zoo of its own (it wraps
+user models); this module provides the flagship model the framework's
+benchmarks, Adasum runs and sharded-training paths exercise.
+
+TPU-first design: all matmuls in bfloat16 (fp32 params), static shapes,
+attention as batched einsums that tile onto the MXU, and parameter
+naming chosen so :func:`horovod_tpu.parallel.sharding.bert_partition_rules`
+can map kernels onto tensor-parallel mesh axes.
+"""
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    # Use jax.checkpoint on each layer to trade FLOPs for HBM
+    # (rematerialisation; essential for long sequence / large batch).
+    remat: bool = False
+
+
+def bert_large_config(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_base_config(**kw) -> BertConfig:
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072, **kw)
+
+
+def bert_tiny_config(**kw) -> BertConfig:
+    """Tiny config for tests and multi-chip dry runs."""
+    defaults = dict(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128,
+                    max_position_embeddings=128,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool = True):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            features=(cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        # [batch, heads, q_len, k_len] — contraction and the subsequent
+        # PV matmul are the MXU hot loops.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        scores = scores / math.sqrt(head_dim)
+        if mask is not None:
+            big_neg = jnp.finfo(cfg.dtype).min
+            scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(cfg.dtype)
+        probs = nn.Dropout(cfg.attention_dropout)(
+            probs, deterministic=deterministic)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              name="out")(ctx)
+        return out
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool = True):
+        cfg = self.config
+        attn_out = SelfAttention(cfg, name="attention")(
+            x, mask, deterministic)
+        attn_out = nn.Dropout(cfg.hidden_dropout)(
+            attn_out, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32,
+                         name="attention_norm")(x + attn_out)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="intermediate")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="output")(h)
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            param_dtype=jnp.float32,
+                            name="output_norm")(x + h)
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        b, s = input_ids.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="position_embeddings")(
+            jnp.arange(s)[None, :])
+        emb = emb + pos
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                                 dtype=cfg.dtype, param_dtype=jnp.float32,
+                                 name="token_type_embeddings")(
+                token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32,
+                         name="embeddings_norm")(emb)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(
+                x, attention_mask, deterministic)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    """Encoder + tied-embedding MLM head (the pretraining objective used
+    by the Adasum BERT-large baseline)."""
+    config: BertConfig
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = BertEncoder(cfg)
+        self.mlm_transform = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                      param_dtype=jnp.float32)
+        self.mlm_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     dtype=cfg.dtype,
+                                     param_dtype=jnp.float32)
+        self.mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                                   (cfg.vocab_size,), jnp.float32)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        x = self.encoder(input_ids, token_type_ids, attention_mask,
+                         deterministic)
+        x = self.mlm_transform(x)
+        x = nn.gelu(x, approximate=True)
+        x = self.mlm_norm(x)
+        # Tied output projection: reuse the word embedding matrix.
+        embedding = self.encoder.variables[
+            "params"]["word_embeddings"]["embedding"]
+        logits = jnp.einsum("bsh,vh->bsv", x, embedding.astype(cfg.dtype))
+        return logits.astype(jnp.float32) + self.mlm_bias
+
+
+def mlm_loss(logits, labels, mask):
+    """Cross-entropy over masked positions; ``mask`` is 1 where the token
+    was masked (predicted)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = mask.astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,46 @@
+"""Small MNIST models — the keras_mnist baseline workload
+(reference: examples/keras/keras_mnist.py uses a small convnet with
+DistributedOptimizer; BASELINE.md lists it as the CPU/Gloo config)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistMLP(nn.Module):
+    hidden: int = 512
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MnistCNN(nn.Module):
+    """Matches the topology of the reference example's Keras model
+    (examples/keras/keras_mnist.py: conv 32 → conv 64 → pool → dense)."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jnp.take_along_axis(
+        nn.log_softmax(logits), labels[:, None], axis=-1)
+    return -logp.mean()

@@ -1,0 +1,121 @@
+"""Path-based partition rules: map parameter/optimizer pytrees onto the
+device mesh.
+
+This is the GSPMD analog of the reference's per-tensor dispatch: instead
+of shipping each tensor to a collective backend at runtime, tensors are
+*annotated* with mesh placements and XLA inserts the collectives
+(psum/all-gather/reduce-scatter) during compilation — the scaling-book
+recipe.  Rules are (regex, PartitionSpec) pairs matched against
+"/"-joined pytree paths, so the same rules shard params AND their
+mirrored optimizer moments (mu/nu subtrees repeat the param paths).
+"""
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def bert_partition_rules(tp: str = "tp",
+                         fsdp: Optional[str] = None) -> Rules:
+    """Megatron-style tensor-parallel sharding for the flax BERT family:
+    QKV projections column-parallel over heads, output row-parallel, MLP
+    in column- / out row-parallel, embeddings vocab-sharded."""
+    f = fsdp  # optional second sharding axis (ZeRO-3 style)
+    return [
+        (r"word_embeddings/embedding$", P(tp, f)),
+        (r"position_embeddings/embedding$", P(None, f)),
+        (r"token_type_embeddings/embedding$", P(None, f)),
+        (r"attention/(query|key|value)/kernel$", P(f, tp, None)),
+        (r"attention/(query|key|value)/bias$", P(tp, None)),
+        (r"attention/out/kernel$", P(tp, None, f)),
+        (r"attention/out/bias$", P(None)),
+        (r"intermediate/kernel$", P(f, tp)),
+        (r"intermediate/bias$", P(tp)),
+        (r"(layer_\d+/)output/kernel$", P(tp, f)),
+        (r"mlm_transform/kernel$", P(None, f)),
+        (r"mlm_bias$", P(tp)),
+        (r".*", P()),  # everything else (norms, small biases) replicated
+    ]
+
+
+def resnet_partition_rules(fsdp: Optional[str] = None) -> Rules:
+    """ResNet is pure data parallel (conv kernels are small); optionally
+    ZeRO-shard the dense head."""
+    return [
+        (r"Dense_0/kernel$", P(fsdp, None) if fsdp else P()),
+        (r".*", P()),
+    ]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Adapt a rule's spec to a concrete leaf: drop axes the shape can't
+    host (rank mismatch or non-divisible dims) so tiny dry-run shapes
+    still compile."""
+    ndim = len(shape)
+    parts = list(spec)
+    if len(parts) > ndim:
+        parts = parts[:ndim]
+    while len(parts) < ndim:
+        parts.append(None)
+    fitted = []
+    for dim, ax in zip(shape, parts):
+        if ax is None:
+            fitted.append(None)
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        if any(a not in mesh.shape for a in axes):
+            # Rule names an axis this mesh doesn't have (e.g. tp rules on
+            # a dp-only mesh): replicate that dimension.
+            fitted.append(None)
+            continue
+        total = 1
+        for a in axes:
+            total *= mesh.shape[a]
+        fitted.append(ax if dim % total == 0 and dim > 0 else None)
+    return P(*fitted)
+
+
+def infer_shardings(tree, mesh: Mesh, rules: Rules):
+    """Produce a pytree of NamedShardings matching ``tree``'s structure.
+
+    Scalars/0-d leaves are replicated.  Works on params and on optimizer
+    states (whose subtrees repeat parameter paths).
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def leaf_sharding(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape:
+            return NamedSharding(mesh, P())
+        s = _path_str(path)
+        for pat, spec in compiled:
+            if pat.search(s):
+                return NamedSharding(mesh, _fit_spec(spec, shape, mesh))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def shard_tree(tree, mesh: Mesh, rules: Rules):
+    """Device-put a pytree according to the rules (for seeding initial
+    state onto the mesh)."""
+    shardings = infer_shardings(tree, mesh, rules)
+    return jax.tree.map(jax.device_put, tree, shardings)

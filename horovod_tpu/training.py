@@ -1,0 +1,149 @@
+"""Sharded SPMD training steps for the flagship models.
+
+The TPU-native core training path: one jit-compiled step per model whose
+parameters, optimizer state and activations are laid out over a named
+mesh (dp / tp / sp / fsdp axes), with XLA inserting the gradient
+allreduce and tensor-parallel collectives (GSPMD).  This is what
+replaces the reference's DistributedOptimizer+NCCL pipeline at full
+performance (reference: torch/optimizer.py:110-236,
+tensorflow/__init__.py:334-381 — gradient hooks feeding allreduce); the
+drop-in per-gradient API also exists (horovod_tpu.jax) but this is the
+path that hits peak MXU/ICI utilisation.
+"""
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.bert import BertConfig, BertForMaskedLM, mlm_loss
+from .parallel.sharding import (bert_partition_rules, infer_shardings,
+                                Rules)
+
+
+class TrainState(train_state.TrainState):
+    pass
+
+
+def factor_mesh_axes(n_devices: int) -> Dict[str, int]:
+    """Factor a device count into (dp, tp, sp) sizes, preferring dp.
+
+    8 → dp2·tp2·sp2, 4 → dp2·tp2, 2 → dp2, 1 → all-1 (degenerate).
+    """
+    axes = {"dp": 1, "tp": 1, "sp": 1}
+    rest = n_devices
+    for name in ("dp", "tp", "sp"):
+        if rest % 2 == 0:
+            axes[name] = 2
+            rest //= 2
+    axes["dp"] *= rest  # absorb any remainder into dp
+    return axes
+
+
+def make_bert_pretrain_step(
+        config: BertConfig, mesh: Mesh,
+        learning_rate: float = 1e-4,
+        rules: Optional[Rules] = None,
+        donate: bool = True,
+        dropout_seed: int = 0,
+) -> Tuple[Callable, "NamedSharding"]:
+    """Returns ``(make_jitted, batch_sharding)``.
+
+    ``make_jitted(example_batch)`` builds and returns the jit-compiled
+    ``(init_fn, step_fn)`` pair for that batch's shapes (shapes are
+    needed to lay out the state sharding before compilation);
+    ``batch_sharding`` is the NamedSharding inputs must be placed with.
+
+    * params/opt-state sharded by Megatron-style rules (tp [+ fsdp]);
+    * batch sharded (dp, sp) over (batch, sequence);
+    * dropout active whenever the config's dropout rates are non-zero,
+      with the rng folded from the step counter (deterministic replay);
+    * gradient reduction over dp and the tp/sp collectives are inserted
+      by XLA (GSPMD) — on TPU hardware they ride ICI.
+    """
+    model = BertForMaskedLM(config)
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+    rules = rules or bert_partition_rules(
+        tp="tp" if "tp" in mesh.shape else None,
+        fsdp="fsdp" if "fsdp" in mesh.shape else None)
+    deterministic = (config.hidden_dropout == 0.0
+                     and config.attention_dropout == 0.0)
+
+    batch_spec = P("dp" if "dp" in mesh.shape else None,
+                   "sp" if "sp" in mesh.shape else None)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    repl = NamedSharding(mesh, P())
+
+    def _init(rng, batch):
+        params = model.init(rng, batch["input_ids"],
+                            deterministic=True)["params"]
+        return TrainState.create(apply_fn=model.apply, params=params,
+                                 tx=tx)
+
+    def _loss_fn(params, batch, dropout_rng):
+        rngs = None if deterministic else {"dropout": dropout_rng}
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             attention_mask=batch.get("attention_mask"),
+                             deterministic=deterministic, rngs=rngs)
+        return mlm_loss(logits, batch["labels"], batch["mask"])
+
+    def _step(state, batch):
+        dropout_rng = jax.random.fold_in(
+            jax.random.PRNGKey(dropout_seed), state.step)
+        loss, grads = jax.value_and_grad(_loss_fn)(
+            state.params, batch, dropout_rng)
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, loss
+
+    # Shapes of the state determine its sharding tree; evaluate
+    # abstractly so no host memory is spent.
+    def make_jitted(example_batch):
+        rng = jax.random.PRNGKey(0)
+        abstract_state = jax.eval_shape(_init, rng, example_batch)
+        state_sharding = infer_shardings(abstract_state, mesh, rules)
+        init_fn = jax.jit(_init, out_shardings=state_sharding)
+        step_fn = jax.jit(
+            _step,
+            in_shardings=(state_sharding,
+                          jax.tree.map(lambda _: batch_sharding,
+                                       example_batch)),
+            out_shardings=(state_sharding, repl),
+            donate_argnums=(0,) if donate else ())
+        return init_fn, step_fn
+
+    return make_jitted, batch_sharding
+
+
+def make_bert_batch(batch_size: int, seq_len: int, vocab_size: int,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    input_ids = rng.randint(0, vocab_size, (batch_size, seq_len),
+                            dtype=np.int32)
+    labels = rng.randint(0, vocab_size, (batch_size, seq_len),
+                         dtype=np.int32)
+    mask = (rng.rand(batch_size, seq_len) < 0.15).astype(np.int32)
+    return {"input_ids": input_ids, "labels": labels, "mask": mask}
+
+
+def run_bert_dry_run(n_devices: int, config: Optional[BertConfig] = None,
+                     batch_size: int = 8, seq_len: int = 64):
+    """One full sharded pretraining step on an ``n_devices`` mesh with
+    tiny shapes — the multi-chip compile/execute validation path."""
+    from .models.bert import bert_tiny_config
+    from .parallel.mesh import build_mesh
+
+    config = config or bert_tiny_config(max_position_embeddings=seq_len)
+    axes = factor_mesh_axes(n_devices)
+    mesh = build_mesh(axes)
+    make_jitted, batch_sharding = make_bert_pretrain_step(config, mesh)
+    batch = make_bert_batch(batch_size, seq_len, config.vocab_size)
+    batch = jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharding), batch)
+    init_fn, step_fn = make_jitted(batch)
+    state = init_fn(jax.random.PRNGKey(0), batch)
+    state, loss = step_fn(state, batch)
+    jax.block_until_ready(loss)
+    return float(loss), mesh
