@@ -115,8 +115,9 @@ def _maybe_init_jax_distributed(info: RankInfo):
     coordinator = os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR)
     if coordinator is None:
         return False
-    if jax.process_count() > 1:
-        return False  # already initialized by the platform
+    # Must not touch the backend (jax.devices/process_count) before
+    # jax.distributed.initialize — probe the distributed client state
+    # directly instead.
     try:
         from jax._src import distributed as _dist
         already = _dist.global_state.client is not None

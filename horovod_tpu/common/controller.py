@@ -101,6 +101,8 @@ def construct_response(name: str, msgs: List[Request], size: int,
         process_set_id=first.process_set_id,
         root_rank=first.root_rank,
         reduce_op=first.reduce_op,
+        tensor_shapes=[tuple(first.tensor_shape)],
+        process_set_ranks=tuple(first.process_set_ranks),
     )
     if first.request_type == RequestType.ALLGATHER:
         # Record each rank's first-dimension size in rank order; joined
@@ -127,7 +129,7 @@ class MessageTable:
                   joined_count: int = 0) -> bool:
         msgs = self.entries.setdefault(req.tensor_name, [])
         msgs.append(req)
-        return len(msgs) + joined_count == required
+        return len(msgs) + joined_count >= required
 
     def pop(self, name: str) -> List[Request]:
         return self.entries.pop(name, [])

@@ -1,0 +1,328 @@
+"""Multi-process controller: coordinator/worker negotiation over TCP.
+
+The TPU port of the reference's coordinator protocol (reference:
+controller.h:69-102 protocol spec; mpi_controller.cc / gloo_controller.cc
+transport implementations): every rank pushes its ready Requests to the
+rank-0 coordinator; the coordinator counts readiness per tensor
+(IncrementTensorCount), validates and constructs fused Responses, and
+broadcasts one ordered ResponseList to every rank.  Each rank then
+executes the identical fused batch — which on the XLA data plane means
+every process enters the same compiled collective program (order
+determinism is what makes the executable cache effective, SURVEY §7).
+
+Deltas from the reference:
+  * event-driven push instead of a 1 ms gather cycle — ranks send only
+    when they have pending work, the coordinator fires a response batch
+    as soon as every rank has reported a tensor (lower latency than
+    cycle polling, no idle chatter over DCN);
+  * transport is plain length-prefixed TCP (no MPI/gloo dependency) —
+    the launcher provides HOROVOD_CONTROLLER_ADDR.
+"""
+
+import logging
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .controller import Controller, MessageTable, construct_response
+from .fusion import fuse_responses
+from .message import (Request, RequestType, Response, ResponseType,
+                      dtype_size, pack_request_list, pack_response_list,
+                      unpack_request_list, unpack_response_list)
+
+logger = logging.getLogger("horovod_tpu.controller_net")
+
+CONTROLLER_ADDR_ENV = "HOROVOD_CONTROLLER_ADDR"
+
+_MAGIC_REQ = b"RQ"
+_MAGIC_RESP = b"RS"
+
+
+def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
+    sock.sendall(magic + struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes]]:
+    head = _recv_exact(sock, 6)
+    if head is None:
+        return None
+    magic, ln = head[:2], struct.unpack("<I", head[2:])[0]
+    payload = _recv_exact(sock, ln)
+    if payload is None:
+        return None
+    return magic, payload
+
+
+class CoordinatorServer:
+    """Rank-0 service: accepts one connection per rank (including a
+    loopback connection from rank 0's own worker), matches requests,
+    broadcasts fused response lists."""
+
+    def __init__(self, size: int, bind_addr: str = "0.0.0.0",
+                 port: int = 0, fusion_threshold: int = 64 << 20,
+                 timeline=None):
+        self.size = size
+        self.fusion_threshold = fusion_threshold
+        self.timeline = timeline
+        self._table = MessageTable()
+        # tensor name -> element count, for fusion byte accounting
+        self._elem_cache: Dict[str, int] = {}
+        self._joined: Set[int] = set()
+        self._last_joined = -1
+        # barrier name -> ranks arrived
+        self._barriers: Dict[str, Set[int]] = {}
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind_addr, port))
+        self._srv.listen(size + 4)
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="hvd-coord-accept", daemon=True)
+        self._threads: List[threading.Thread] = []
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        self._srv.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # First frame identifies the rank.  Bound the wait so a
+            # connected-but-silent client can't stall registration of
+            # the remaining ranks.
+            conn.settimeout(30.0)
+            try:
+                frame = _recv_frame(conn)
+            except (socket.timeout, OSError):
+                conn.close()
+                continue
+            conn.settimeout(None)
+            if frame is None:
+                conn.close()
+                continue
+            rank = struct.unpack("<i", frame[1])[0]
+            with self._lock:
+                self._conns[rank] = conn
+            t = threading.Thread(target=self._rank_loop, args=(rank, conn),
+                                 name=f"hvd-coord-rank{rank}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _rank_loop(self, rank: int, conn: socket.socket):
+        while not self._stop.is_set():
+            frame = _recv_frame(conn)
+            if frame is None:
+                return
+            _, payload = frame
+            requests, shutdown = unpack_request_list(payload)
+            if shutdown:
+                return
+            self._handle_requests(rank, requests)
+
+    @staticmethod
+    def _required_for(req: Request) -> int:
+        return len(req.process_set_ranks) if req.process_set_ranks else 0
+
+    def _joined_count_for(self, req: Request) -> int:
+        if req.process_set_ranks:
+            return len(self._joined & set(req.process_set_ranks))
+        return len(self._joined)
+
+    def _scan_complete(self) -> List[Response]:
+        """Re-scan the message table for tensors completed by a rank
+        joining (the reference fires pending tensors when join
+        participation changes, controller.cc:254-308)."""
+        ready: List[Response] = []
+        for name in list(self._table.entries.keys()):
+            msgs = self._table.entries[name]
+            if not msgs:
+                continue
+            required = self._required_for(msgs[0]) or self.size
+            if len(msgs) + self._joined_count_for(msgs[0]) >= required:
+                self._table.pop(name)
+                ready.append(construct_response(
+                    name, msgs, self.size, self._joined))
+        return ready
+
+    def _handle_requests(self, rank: int, requests: List[Request]):
+        """Accumulate; fire a fused broadcast with everything that became
+        ready (single-threaded per coordinator via the lock: ordering of
+        broadcast frames is the global execution order)."""
+        with self._lock:
+            ready: List[Response] = []
+            for req in requests:
+                n = 1
+                for d in req.tensor_shape:
+                    n *= d
+                self._elem_cache[req.tensor_name] = n
+                if req.request_type == RequestType.JOIN:
+                    self._joined.add(rank)
+                    self._last_joined = rank
+                    if len(self._joined) == self.size:
+                        ready.append(Response(
+                            response_type=ResponseType.JOIN,
+                            tensor_names=["join"],
+                            last_joined_rank=self._last_joined))
+                        self._joined.clear()
+                    else:
+                        # Tensors waiting only on the joined rank are
+                        # now complete (zeros substituted).
+                        ready.extend(self._scan_complete())
+                    continue
+                if req.request_type == RequestType.BARRIER:
+                    required = self._required_for(req) or self.size
+                    arrived = self._barriers.setdefault(
+                        req.tensor_name, set())
+                    arrived.add(rank)
+                    if len(arrived) >= required:
+                        del self._barriers[req.tensor_name]
+                        ready.append(Response(
+                            response_type=ResponseType.BARRIER,
+                            tensor_names=[req.tensor_name],
+                            process_set_id=req.process_set_id,
+                            process_set_ranks=req.process_set_ranks))
+                    continue
+                required = self._required_for(req) or self.size
+                complete = self._table.increment(
+                    req, required,
+                    joined_count=self._joined_count_for(req))
+                if self.timeline:
+                    self.timeline.negotiate_rank_ready(
+                        req.tensor_name, rank)
+                if complete:
+                    msgs = self._table.pop(req.tensor_name)
+                    ready.append(construct_response(
+                        req.tensor_name, msgs, self.size, self._joined))
+            if not ready:
+                return
+            fused = fuse_responses(ready, self._elem_cache,
+                                   self.fusion_threshold)
+            payload = pack_response_list(fused)
+            dead = []
+            for r, conn in self._conns.items():
+                try:
+                    _send_frame(conn, _MAGIC_RESP, payload)
+                except OSError:
+                    dead.append(r)
+            for r in dead:
+                self._conns.pop(r, None)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class NetworkController(Controller):
+    """Per-rank controller client.  Rank 0 additionally hosts the
+    CoordinatorServer (mirroring the reference where rank 0 is both a
+    worker and the coordinator, controller.cc:69-449)."""
+
+    def __init__(self, state):
+        super().__init__(state)
+        self.server: Optional[CoordinatorServer] = None
+        addr = os.environ.get(CONTROLLER_ADDR_ENV)
+        if self.rank == 0:
+            port = 0
+            if addr and ":" in addr:
+                port = int(addr.rsplit(":", 1)[1])
+            self.server = CoordinatorServer(
+                self.size, port=port,
+                fusion_threshold=state.knobs.fusion_threshold_bytes,
+                timeline=state.timeline)
+            host = "127.0.0.1"
+            self._addr = (host, self.server.port)
+        else:
+            if not addr:
+                raise RuntimeError(
+                    f"{CONTROLLER_ADDR_ENV} must be set for multi-process "
+                    "runs (the launcher sets it automatically).")
+            host, port = addr.rsplit(":", 1)
+            self._addr = (host, int(port))
+        self._sock = self._connect()
+        self._recv_buf: "queue.Queue" = queue.Queue()
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, name="hvd-ctrl-recv", daemon=True)
+        self._recv_thread.start()
+        self._send_lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        deadline = time.monotonic() + 120.0
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                s = socket.create_connection(self._addr, timeout=5.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                _send_frame(s, _MAGIC_REQ, struct.pack("<i", self.rank))
+                return s
+            except OSError as e:
+                last_err = e
+                time.sleep(0.2)
+        raise ConnectionError(
+            f"could not reach coordinator at {self._addr}: {last_err}")
+
+    def _recv_loop(self):
+        while True:
+            try:
+                frame = _recv_frame(self._sock)
+            except OSError:
+                return
+            if frame is None:
+                return
+            _, payload = frame
+            responses, _ = unpack_response_list(payload)
+            self._recv_buf.put(responses)
+
+    def compute_response_list(self, pending, entry_sizes, threshold_bytes):
+        if pending:
+            with self._send_lock:
+                _send_frame(self._sock, _MAGIC_REQ,
+                            pack_request_list(pending))
+        responses: List[Response] = []
+        try:
+            # Block briefly: either a batch arrives or the cycle ends.
+            responses.extend(self._recv_buf.get(timeout=0.005))
+            while True:
+                responses.extend(self._recv_buf.get_nowait())
+        except queue.Empty:
+            pass
+        return responses, []
+
+    def shutdown(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self.server is not None:
+            self.server.stop()

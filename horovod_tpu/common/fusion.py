@@ -78,6 +78,9 @@ def fuse_responses(responses: List[Response], entry_sizes,
                         process_set_id=fused.process_set_id,
                         reduce_op=fused.reduce_op,
                         root_rank=fused.root_rank,
+                        tensor_shapes=(fused.tensor_shapes +
+                                       cand.tensor_shapes),
+                        process_set_ranks=fused.process_set_ranks,
                     )
                     acc_bytes += cand_bytes
                     queue.pop(i)

@@ -109,6 +109,10 @@ class Request:
     process_set_id: int = 0
     # Horovod reduce op requested ("Sum"/"Average"/"Adasum"/...)
     reduce_op: str = "Sum"
+    # Member ranks of the process set (empty = global world).  Carried on
+    # the wire so the coordinator knows the required count without a
+    # separate registration protocol.
+    process_set_ranks: Tuple[int, ...] = ()
 
     def nbytes(self) -> int:
         n = 1
@@ -116,36 +120,40 @@ class Request:
             n *= d
         return n * dtype_size(self.tensor_type)
 
-    _FMT = "<iiB i i d d i i"
-
     def to_bytes(self) -> bytes:
         name_b = self.tensor_name.encode()
         op_b = self.reduce_op.encode()
         shape = self.tensor_shape
+        psr = self.process_set_ranks
         head = struct.pack(
-            "<iiiiiddiiHH", self.request_rank, int(self.request_type),
+            "<iiiiiddiiHHH", self.request_rank, int(self.request_type),
             int(self.tensor_type), self.root_rank, self.device,
             self.prescale_factor, self.postscale_factor,
-            self.process_set_id, len(shape), len(name_b), len(op_b))
-        return head + struct.pack(f"<{len(shape)}q", *shape) + name_b + op_b
+            self.process_set_id, len(shape), len(name_b), len(op_b),
+            len(psr))
+        return (head + struct.pack(f"<{len(shape)}q", *shape) + name_b +
+                op_b + struct.pack(f"<{len(psr)}i", *psr))
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Request":
-        head_fmt = "<iiiiiddiiHH"
+        head_fmt = "<iiiiiddiiHHH"
         head_size = struct.calcsize(head_fmt)
         (rank, rtype, dtype, root, device, pre, post, psid, ndim,
-         name_len, op_len) = struct.unpack_from(head_fmt, data)
+         name_len, op_len, n_psr) = struct.unpack_from(head_fmt, data)
         off = head_size
         shape = struct.unpack_from(f"<{ndim}q", data, off)
         off += 8 * ndim
         name = data[off:off + name_len].decode()
         off += name_len
         op = data[off:off + op_len].decode()
+        off += op_len
+        psr = struct.unpack_from(f"<{n_psr}i", data, off)
         return cls(request_rank=rank, request_type=RequestType(rtype),
                    tensor_name=name, tensor_shape=tuple(shape),
                    tensor_type=DataType(dtype), root_rank=root,
                    device=device, prescale_factor=pre, postscale_factor=post,
-                   process_set_id=psid, reduce_op=op)
+                   process_set_id=psid, reduce_op=op,
+                   process_set_ranks=tuple(psr))
 
 
 @dataclass
@@ -164,16 +172,24 @@ class Response:
     root_rank: int = -1
     reduce_op: str = "Sum"
     last_joined_rank: int = -1
+    # Per-tensor shapes aligned with tensor_names, so joined (departed)
+    # ranks can substitute correctly-shaped zeros (JoinOp semantics,
+    # reference collective_operations.h:259-276).
+    tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
+    process_set_ranks: Tuple[int, ...] = ()
 
     def to_bytes(self) -> bytes:
         err_b = self.error_message.encode()
         op_b = self.reduce_op.encode()
         names_b = [n.encode() for n in self.tensor_names]
+        psr = self.process_set_ranks
         head = struct.pack(
-            "<iiddiiiHHHH", int(self.response_type), int(self.tensor_type),
+            "<iiddiiiHHHHHH", int(self.response_type),
+            int(self.tensor_type),
             self.prescale_factor, self.postscale_factor,
             self.process_set_id, self.root_rank, self.last_joined_rank,
-            len(names_b), len(self.tensor_sizes), len(err_b), len(op_b))
+            len(names_b), len(self.tensor_sizes), len(err_b), len(op_b),
+            len(self.tensor_shapes), len(psr))
         parts = [head]
         for nb in names_b:
             parts.append(struct.pack("<H", len(nb)))
@@ -182,13 +198,18 @@ class Response:
                                  *self.tensor_sizes))
         parts.append(err_b)
         parts.append(op_b)
+        for shape in self.tensor_shapes:
+            parts.append(struct.pack("<H", len(shape)))
+            parts.append(struct.pack(f"<{len(shape)}q", *shape))
+        parts.append(struct.pack(f"<{len(psr)}i", *psr))
         return b"".join(parts)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Response":
-        head_fmt = "<iiddiiiHHHH"
+        head_fmt = "<iiddiiiHHHHHH"
         (rtype, dtype, pre, post, psid, root, last_joined, n_names,
-         n_sizes, err_len, op_len) = struct.unpack_from(head_fmt, data)
+         n_sizes, err_len, op_len, n_shapes,
+         n_psr) = struct.unpack_from(head_fmt, data)
         off = struct.calcsize(head_fmt)
         names = []
         for _ in range(n_names):
@@ -201,12 +222,21 @@ class Response:
         err = data[off:off + err_len].decode()
         off += err_len
         op = data[off:off + op_len].decode()
+        off += op_len
+        shapes = []
+        for _ in range(n_shapes):
+            (nd,) = struct.unpack_from("<H", data, off)
+            off += 2
+            shapes.append(tuple(struct.unpack_from(f"<{nd}q", data, off)))
+            off += 8 * nd
+        psr = tuple(struct.unpack_from(f"<{n_psr}i", data, off))
         return cls(response_type=ResponseType(rtype),
                    tensor_type=DataType(dtype), prescale_factor=pre,
                    postscale_factor=post, process_set_id=psid,
                    root_rank=root, last_joined_rank=last_joined,
                    tensor_names=names, tensor_sizes=sizes,
-                   error_message=err, reduce_op=op)
+                   error_message=err, reduce_op=op, tensor_shapes=shapes,
+                   process_set_ranks=psr)
 
 
 def pack_request_list(requests: List[Request],

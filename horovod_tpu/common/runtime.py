@@ -51,6 +51,11 @@ class BackgroundRuntime:
         self._joined = False
         self._error: Optional[Exception] = None
 
+    def set_joined(self, flag: bool):
+        """While joined, this rank substitutes zeros for collectives it
+        did not submit (JoinOp, reference collective_operations.h:259)."""
+        self._joined = flag
+
     def _make_controller(self):
         if self.state.rank_info.size == 1:
             return LoopbackController(self.state)
@@ -145,9 +150,31 @@ class BackgroundRuntime:
     # ------------------------------------------------------------------
     def _perform_operation(self, resp: Response):
         backend = self.state.backend
+        my_rank = self.state.rank_info.rank
+        if resp.process_set_ranks and my_rank not in resp.process_set_ranks:
+            # A process-set collective this rank is not a member of: the
+            # coordinator broadcasts to everyone, non-members simply
+            # don't participate in the sub-mesh program.
+            return
         entries: List[TensorTableEntry] = []
-        for name in resp.tensor_names:
+        for i, name in enumerate(resp.tensor_names):
             e = self.tensor_queue.pop_entry(name, resp.process_set_id)
+            if e is None and self._joined and resp.response_type in (
+                    ResponseType.ALLREDUCE, ResponseType.ADASUM,
+                    ResponseType.ALLGATHER, ResponseType.BROADCAST,
+                    ResponseType.REDUCESCATTER):
+                # Joined rank: substitute a zero tensor so the compiled
+                # collective still has all participants.
+                import numpy as np
+                from .message import np_dtype
+                shape = tuple(resp.tensor_shapes[i]) \
+                    if i < len(resp.tensor_shapes) else ()
+                if resp.response_type == ResponseType.ALLGATHER:
+                    shape = (0,) + shape[1:]
+                zero = np.zeros(shape, dtype=np_dtype(resp.tensor_type))
+                e = TensorTableEntry(tensor_name=name, tensor=zero,
+                                     callback=lambda ok, r: None,
+                                     process_set_id=resp.process_set_id)
             if e is not None:
                 entries.append(e)
             if self.stall_inspector is not None:
@@ -173,6 +200,7 @@ class BackgroundRuntime:
 
         names = [e.tensor_name for e in entries]
         tl_name = names[0]
+        ps_ranks = tuple(resp.process_set_ranks)
         try:
             if self.timeline:
                 self.timeline.start_activity(
@@ -181,30 +209,30 @@ class BackgroundRuntime:
                 arrays = [e.tensor for e in entries]
                 results = backend.allreduce(
                     arrays, resp.reduce_op, resp.prescale_factor,
-                    resp.postscale_factor, resp.process_set_id)
+                    resp.postscale_factor, ps_ranks)
             elif resp.response_type == ResponseType.ADASUM:
                 arrays = [e.tensor for e in entries]
                 results = backend.adasum_allreduce(
                     arrays, resp.prescale_factor, resp.postscale_factor,
-                    resp.process_set_id)
+                    ps_ranks)
             elif resp.response_type == ResponseType.ALLGATHER:
                 results = backend.allgather(
                     [e.tensor for e in entries], resp.tensor_sizes,
-                    resp.process_set_id)
+                    ps_ranks)
             elif resp.response_type == ResponseType.BROADCAST:
                 results = backend.broadcast(
                     [e.tensor for e in entries], resp.root_rank,
-                    resp.process_set_id)
+                    ps_ranks)
             elif resp.response_type == ResponseType.ALLTOALL:
                 results = []
                 for e in entries:
                     out, recv_splits = backend.alltoall(
-                        e.tensor, e.splits, resp.process_set_id)
+                        e.tensor, e.splits, ps_ranks)
                     results.append((out, recv_splits))
             elif resp.response_type == ResponseType.REDUCESCATTER:
                 results = backend.reducescatter(
                     [e.tensor for e in entries], resp.reduce_op,
-                    resp.process_set_id)
+                    ps_ranks)
             else:
                 raise RuntimeError(
                     f"Unknown response type {resp.response_type}")

@@ -41,36 +41,37 @@ def _scale(x, factor: float):
 
 
 class Backend:
+    """``ps_ranks`` on every method is the process-set member tuple
+    (empty = the global world)."""
     name = "abstract"
 
-    def world_size(self, process_set_id: int = 0) -> int:
+    def world_size(self, ps_ranks: Tuple[int, ...] = ()) -> int:
         raise NotImplementedError
 
     def allreduce(self, arrays: List[Any], reduce_op: str, prescale: float,
-                  postscale: float, process_set_id: int) -> List[Any]:
+                  postscale: float, ps_ranks=()) -> List[Any]:
         raise NotImplementedError
 
     def adasum_allreduce(self, arrays, prescale, postscale,
-                         process_set_id) -> List[Any]:
+                         ps_ranks=()) -> List[Any]:
         raise NotImplementedError
 
     def allgather(self, arrays: List[Any], sizes: List[int],
-                  process_set_id: int) -> List[Any]:
+                  ps_ranks=()) -> List[Any]:
         raise NotImplementedError
 
     def broadcast(self, arrays: List[Any], root_rank: int,
-                  process_set_id: int) -> List[Any]:
+                  ps_ranks=()) -> List[Any]:
         raise NotImplementedError
 
-    def alltoall(self, array, splits, process_set_id: int
-                 ) -> Tuple[Any, Any]:
+    def alltoall(self, array, splits, ps_ranks=()) -> Tuple[Any, Any]:
         raise NotImplementedError
 
     def reducescatter(self, arrays: List[Any], reduce_op: str,
-                      process_set_id: int) -> List[Any]:
+                      ps_ranks=()) -> List[Any]:
         raise NotImplementedError
 
-    def barrier(self, process_set_id: int = 0):
+    def barrier(self, ps_ranks=()):
         raise NotImplementedError
 
 
@@ -83,11 +84,11 @@ class SingleProcessBackend(Backend):
     """
     name = "single"
 
-    def world_size(self, process_set_id: int = 0) -> int:
+    def world_size(self, ps_ranks=()) -> int:
         return 1
 
     def allreduce(self, arrays, reduce_op, prescale, postscale,
-                  process_set_id):
+                  ps_ranks=()):
         out = []
         for x in arrays:
             y = _scale(x, prescale)
@@ -95,26 +96,26 @@ class SingleProcessBackend(Backend):
             out.append(y)
         return out
 
-    def adasum_allreduce(self, arrays, prescale, postscale, process_set_id):
+    def adasum_allreduce(self, arrays, prescale, postscale, ps_ranks=()):
         return self.allreduce(arrays, "Adasum", prescale, postscale,
-                              process_set_id)
+                              ps_ranks)
 
-    def allgather(self, arrays, sizes, process_set_id):
+    def allgather(self, arrays, sizes, ps_ranks=()):
         return list(arrays)
 
-    def broadcast(self, arrays, root_rank, process_set_id):
+    def broadcast(self, arrays, root_rank, ps_ranks=()):
         return list(arrays)
 
-    def alltoall(self, array, splits, process_set_id):
+    def alltoall(self, array, splits, ps_ranks=()):
         if splits is None:
             return array, None
         recv_splits = np.asarray(splits)
         return array, recv_splits
 
-    def reducescatter(self, arrays, reduce_op, process_set_id):
+    def reducescatter(self, arrays, reduce_op, ps_ranks=()):
         return list(arrays)
 
-    def barrier(self, process_set_id: int = 0):
+    def barrier(self, ps_ranks=()):
         return None
 
 

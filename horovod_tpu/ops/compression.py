@@ -1,0 +1,81 @@
+"""Gradient compression algorithms.
+
+Mirrors the reference compression API (reference:
+tensorflow/compression.py:46-74, torch/compression.py — a Compressor
+with compress/decompress returning (tensor, ctx), selected via
+``Compression.none`` / ``Compression.fp16``).
+
+On TPU bf16 is the natural wire format (same 8-bit exponent as fp32 —
+no range loss, MXU-native), so ``Compression.bf16`` is added alongside
+fp16 parity.
+"""
+
+import numpy as np
+
+
+def _astype(tensor, dtype):
+    if hasattr(tensor, "astype"):
+        return tensor.astype(dtype)
+    return np.asarray(tensor).astype(dtype)
+
+
+class Compressor:
+    """Interface: compress returns (compressed_tensor, ctx);
+    decompress(tensor, ctx) restores the original dtype."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for the wire; restore on receive."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if np.issubdtype(np.dtype(str(dtype)) if not hasattr(dtype, "kind")
+                         else dtype, np.floating) and str(dtype) != "float16":
+            return _astype(tensor, "float16"), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else _astype(tensor, ctx)
+
+
+class BF16Compressor(Compressor):
+    """TPU-native: bfloat16 wire format (fp32 exponent range preserved)."""
+
+    @staticmethod
+    def compress(tensor):
+        import jax.numpy as jnp
+        dtype = tensor.dtype
+        if str(dtype) in ("float32", "float64"):
+            return _astype(tensor, jnp.bfloat16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else _astype(tensor, ctx)
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

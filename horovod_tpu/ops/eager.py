@@ -112,6 +112,7 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
         postscale_factor=postscale,
         process_set_id=process_set.process_set_id,
         reduce_op=reduce_op,
+        process_set_ranks=tuple(process_set.ranks or ()),
     )
     runtime.submit(req, entry)
     return handle
@@ -178,7 +179,8 @@ def grouped_allreduce_async(tensors: Sequence[Any], average=None, name=None,
             tensor_type=dtype_of(t), prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             process_set_id=process_set.process_set_id,
-            reduce_op=reduce_op))
+            reduce_op=reduce_op,
+            process_set_ranks=tuple(process_set.ranks or ())))
     runtime.submit_group(reqs, entries)
     return handles
 
@@ -259,12 +261,26 @@ def join() -> int:
     """Graceful early exit: this rank stops contributing; other ranks'
     collectives substitute zeros for it.  Blocks until every rank joins
     and returns the last-joined rank (reference: operations.cc:1164-1188,
-    torch/mpi_ops.py:846-870)."""
-    h = _submit(RequestType.JOIN, None, f"join.{basics.rank()}")
-    return h.wait()
+    torch/mpi_ops.py:846-870).
+
+    The entry name is the fixed "join" on every rank: the coordinator's
+    JOIN response names it so each rank pops its own entry.  While
+    joined, the background runtime substitutes zero tensors for this
+    rank's missing contributions (JoinOp semantics).
+    """
+    runtime = _runtime()
+    runtime.set_joined(True)
+    h = _submit(RequestType.JOIN, None, "join")
+    try:
+        return h.wait()
+    finally:
+        runtime.set_joined(False)
 
 
 def barrier(process_set=global_process_set):
+    # Fixed per-process-set name: every rank must use the same tensor
+    # name or the coordinator's response wouldn't match local entries.
     h = _submit(RequestType.BARRIER, None,
-                _auto_name("barrier", None), process_set=process_set)
+                f"barrier.ps{process_set.process_set_id}",
+                process_set=process_set)
     return h.wait()

@@ -1,0 +1,358 @@
+"""Multi-process XLA data plane: fused collectives over the global mesh.
+
+The TPU analog of the reference's NCCL ops (reference:
+ops/nccl_operations.{h,cc} — device-resident fused-buffer collectives):
+every process places its tensor as one shard of a global array over a
+"world" mesh (one representative device per process), and the fused
+batch executes as a single jit-compiled program of XLA collectives —
+riding ICI between chips of one slice and DCN across slices.
+
+Compiled-executable caching is jax.jit's: a fused batch with the same
+(op, shapes, dtypes) signature reuses its executable, which is exactly
+the response-cache → executable-cache mapping described in SURVEY §7.
+
+Process sets execute on sub-meshes containing only the member ranks'
+devices (the analog of subset communicators, reference
+controller.h:112-117); non-member processes skip the program entirely.
+
+On CPU test rigs the same code runs over the gloo cross-process
+collective implementation (see basics._maybe_init_jax_distributed).
+"""
+
+import logging
+from functools import lru_cache
+from typing import Any, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .backend import Backend
+
+logger = logging.getLogger("horovod_tpu.xla_ops")
+
+
+def _is_unsigned(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.unsignedinteger)
+
+
+def _reduce(x, reduce_op: str, axis: str):
+    """Dtype-correct reduction.  Min/Max for unsigned ints can't use the
+    negate-pmax trick (wraparound), so they gather+reduce instead."""
+    if reduce_op == "Sum":
+        return jax.lax.psum(x, axis)
+    if reduce_op == "Average":
+        return jax.lax.pmean(x, axis)
+    if reduce_op == "Max":
+        if _is_unsigned(x):
+            return jnp.max(jax.lax.all_gather(x, axis), axis=0)
+        return jax.lax.pmax(x, axis)
+    if reduce_op == "Min":
+        if _is_unsigned(x):
+            return jnp.min(jax.lax.all_gather(x, axis), axis=0)
+        return -jax.lax.pmax(-x, axis)
+    if reduce_op == "Product":
+        return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
+    raise ValueError(f"unknown reduce op {reduce_op!r}")
+
+
+class XlaMeshBackend(Backend):
+    name = "xla"
+
+    def __init__(self, state):
+        self.state = state
+        self.size = state.rank_info.size
+        self.rank = state.rank_info.rank
+        devices = jax.devices()
+        by_proc = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        if len(by_proc) != self.size:
+            raise RuntimeError(
+                f"jax sees {len(by_proc)} processes but HOROVOD_SIZE="
+                f"{self.size}; was jax.distributed initialized?")
+        # One representative device per process carries the eager data
+        # plane; in-graph training uses the full device set.  Rank order
+        # must match HOROVOD_RANK order == jax process index order (the
+        # launcher assigns both from the same slot plan).
+        self._reps = [sorted(v, key=lambda d: d.id)[0]
+                      for _, v in sorted(by_proc.items())]
+        self.mesh = Mesh(np.array(self._reps), ("world",))
+        self.rep_device = self._reps[jax.process_index()]
+
+    # ------------------------------------------------------------------
+    # process-set sub-meshes
+    # ------------------------------------------------------------------
+    @lru_cache(maxsize=64)
+    def _submesh(self, ps_ranks: Tuple[int, ...]) -> Mesh:
+        if not ps_ranks:
+            return self.mesh
+        return Mesh(np.array([self._reps[r] for r in ps_ranks]),
+                    ("world",))
+
+    def _group(self, ps_ranks: Tuple[int, ...]):
+        """(mesh, group_size, my_index) for a process set."""
+        if not ps_ranks:
+            return self.mesh, self.size, self.rank
+        return (self._submesh(tuple(ps_ranks)), len(ps_ranks),
+                list(ps_ranks).index(self.rank))
+
+    def world_size(self, ps_ranks=()) -> int:
+        return len(ps_ranks) if ps_ranks else self.size
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _to_global(self, x, mesh: Mesh, group_size: int):
+        """Place this process's tensor as its shard of the
+        (group_size, ...) global array."""
+        was_jax = isinstance(x, jax.Array)
+        arr = np.asarray(x) if not was_jax else x
+        local = jax.device_put(jnp.asarray(arr)[None], self.rep_device)
+        g = jax.make_array_from_single_device_arrays(
+            (group_size,) + tuple(arr.shape),
+            NamedSharding(mesh, P("world")), [local])
+        return g, was_jax
+
+    @staticmethod
+    def _from_replicated(g: jax.Array, was_jax: bool):
+        local = g.addressable_data(0)
+        return local if was_jax else np.asarray(local)
+
+    # ------------------------------------------------------------------
+    # allreduce
+    # ------------------------------------------------------------------
+    @staticmethod
+    @lru_cache(maxsize=512)
+    def _allreduce_fn(mesh, n: int, reduce_op: str, prescale: float,
+                      postscale: float):
+        def body(*xs):
+            out = []
+            for x in xs:
+                x = x[0]  # this process's shard (1, ...) -> (...)
+                if prescale != 1.0:
+                    x = (x * jnp.asarray(prescale, x.dtype)
+                         if jnp.issubdtype(x.dtype, jnp.inexact)
+                         else (x * prescale).astype(x.dtype))
+                y = _reduce(x, reduce_op, "world")
+                if postscale != 1.0:
+                    y = (y * jnp.asarray(postscale, y.dtype)
+                         if jnp.issubdtype(y.dtype, jnp.inexact)
+                         else (y * postscale).astype(y.dtype))
+                out.append(y)
+            return tuple(out)
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P("world") for _ in range(n)),
+            out_specs=tuple(P() for _ in range(n)), check_vma=False))
+
+    def allreduce(self, arrays, reduce_op, prescale, postscale,
+                  ps_ranks=()):
+        mesh, gsize, _ = self._group(tuple(ps_ranks))
+        globals_, meta = [], []
+        for x in arrays:
+            g, was_jax = self._to_global(x, mesh, gsize)
+            globals_.append(g)
+            meta.append(was_jax)
+        fn = self._allreduce_fn(mesh, len(globals_), reduce_op,
+                                float(prescale), float(postscale))
+        outs = fn(*globals_)
+        return [self._from_replicated(o, wj)
+                for o, wj in zip(outs, meta)]
+
+    def adasum_allreduce(self, arrays, prescale, postscale, ps_ranks=()):
+        from .adasum import adasum_allreduce_global
+        mesh, gsize, _ = self._group(tuple(ps_ranks))
+        return adasum_allreduce_global(
+            mesh, self.rep_device, gsize, arrays, prescale, postscale)
+
+    # ------------------------------------------------------------------
+    # allgather (per-tensor per-rank sizes via padding)
+    # ------------------------------------------------------------------
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _gather_fn(mesh, n: int):
+        def body(*xs):
+            return tuple(
+                jax.lax.all_gather(x[0], "world", axis=0, tiled=False)
+                for x in xs)
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P("world") for _ in range(n)),
+            out_specs=tuple(P() for _ in range(n)), check_vma=False))
+
+    def allgather(self, arrays, sizes, ps_ranks=()):
+        """``sizes`` holds ``group_size`` entries per tensor, in tensor
+        order (fused responses concatenate them)."""
+        mesh, gsize, _ = self._group(tuple(ps_ranks))
+        per_tensor_sizes = [sizes[i * gsize:(i + 1) * gsize]
+                            for i in range(len(arrays))]
+        globals_, meta = [], []
+        for x, tsizes in zip(arrays, per_tensor_sizes):
+            was_jax = isinstance(x, jax.Array)
+            arr = jnp.asarray(x)
+            if arr.ndim == 0:
+                arr = arr[None]
+            rows = arr.shape[0]
+            max_rows = max(tsizes) if tsizes else rows
+            if rows < max_rows:
+                pad_widths = [(0, max_rows - rows)] + \
+                    [(0, 0)] * (arr.ndim - 1)
+                arr = jnp.pad(arr, pad_widths)
+            g, _ = self._to_global(arr, mesh, gsize)
+            globals_.append(g)
+            meta.append((was_jax, tsizes))
+        fn = self._gather_fn(mesh, len(globals_))
+        outs = fn(*globals_)
+        results = []
+        for o, (was_jax, tsizes) in zip(outs, meta):
+            full = np.asarray(o.addressable_data(0))  # (group, maxrows, …)
+            pieces = [full[r, :tsizes[r]] for r in range(gsize)]
+            cat = np.concatenate(pieces, axis=0)
+            results.append(jnp.asarray(cat) if was_jax else cat)
+        return results
+
+    # ------------------------------------------------------------------
+    # broadcast
+    # ------------------------------------------------------------------
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _bcast_fn(mesh, n: int, root: int):
+        def body(*xs):
+            out = []
+            for x in xs:
+                x = x[0]
+                idx = jax.lax.axis_index("world")
+                masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+                out.append(jax.lax.psum(masked, "world"))
+            return tuple(out)
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P("world") for _ in range(n)),
+            out_specs=tuple(P() for _ in range(n)), check_vma=False))
+
+    def broadcast(self, arrays, root_rank, ps_ranks=()):
+        mesh, gsize, _ = self._group(tuple(ps_ranks))
+        root = list(ps_ranks).index(root_rank) if ps_ranks else root_rank
+        globals_, meta = [], []
+        for x in arrays:
+            g, was_jax = self._to_global(x, mesh, gsize)
+            globals_.append(g)
+            meta.append(was_jax)
+        fn = self._bcast_fn(mesh, len(globals_), int(root))
+        outs = fn(*globals_)
+        return [self._from_replicated(o, wj)
+                for o, wj in zip(outs, meta)]
+
+    # ------------------------------------------------------------------
+    # alltoall (uneven splits via pad-to-max exchange)
+    # ------------------------------------------------------------------
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _a2a_fn(mesh):
+        def body(x):
+            y = jax.lax.all_to_all(x[0], "world", split_axis=0,
+                                   concat_axis=0, tiled=True)
+            return y[None]
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P("world"), out_specs=P("world"),
+            check_vma=False))
+
+    def alltoall(self, array, splits, ps_ranks=()):
+        mesh, gsize, my_idx = self._group(tuple(ps_ranks))
+        was_jax = isinstance(array, jax.Array)
+        arr = np.asarray(array)
+        if splits is None:
+            base = arr.shape[0] // gsize
+            rem = arr.shape[0] % gsize
+            splits = np.array(
+                [base + (1 if r < rem else 0) for r in range(gsize)],
+                dtype=np.int64)
+        splits = np.asarray(splits, dtype=np.int64)
+        # Exchange the split matrix first (one fused gather).
+        split_mat = np.asarray(self.allgather(
+            [splits], sizes=[gsize] * gsize,
+            ps_ranks=ps_ranks)[0]).reshape(gsize, gsize)
+        recv_splits = split_mat[:, my_idx].copy()
+        maxchunk = int(split_mat.max()) if split_mat.size else 0
+        rest = arr.shape[1:]
+        chunks = np.zeros((gsize, maxchunk) + rest, dtype=arr.dtype)
+        off = 0
+        for r in range(gsize):
+            c = int(splits[r])
+            chunks[r, :c] = arr[off:off + c]
+            off += c
+        g, _ = self._to_global(chunks, mesh, gsize)
+        out = self._a2a_fn(mesh)(g)
+        mine = np.asarray(out.addressable_data(0))[0]  # (group, maxchunk,…)
+        pieces = [mine[r, :int(recv_splits[r])] for r in range(gsize)]
+        result = np.concatenate(pieces, axis=0) if pieces else mine[:0]
+        if was_jax:
+            result = jnp.asarray(result)
+        return result, recv_splits
+
+    # ------------------------------------------------------------------
+    # reducescatter — device-side psum_scatter (1/size the bandwidth of
+    # allreduce-then-slice; this is the FSDP building block)
+    # ------------------------------------------------------------------
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _rs_fn(mesh, n: int, reduce_op: str):
+        def body(*xs):
+            out = []
+            for x in xs:
+                x = x[0]  # (group*chunk, ...) contribution
+                if reduce_op == "Average":
+                    y = jax.lax.psum_scatter(
+                        x, "world", scatter_dimension=0, tiled=True)
+                    y = y / jax.lax.psum(1, "world")
+                else:
+                    y = jax.lax.psum_scatter(
+                        x, "world", scatter_dimension=0, tiled=True)
+                out.append(y[None])
+            return tuple(out)
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P("world") for _ in range(n)),
+            out_specs=tuple(P("world") for _ in range(n)),
+            check_vma=False))
+
+    def reducescatter(self, arrays, reduce_op, ps_ranks=()):
+        """Rank r receives its dim-0 shard of the sum; first ranks absorb
+        the remainder (uneven-split convention matching allgather)."""
+        mesh, gsize, my_idx = self._group(tuple(ps_ranks))
+        prepped, meta = [], []
+        for x in arrays:
+            was_jax = isinstance(x, jax.Array)
+            arr = np.asarray(x)
+            rows = arr.shape[0]
+            base, rem = divmod(rows, gsize)
+            chunk = base + (1 if rem else 0)
+            counts = [base + (1 if r < rem else 0) for r in range(gsize)]
+            starts = np.cumsum([0] + counts[:-1])
+            # Boundary-correct layout: slot r of the padded buffer holds
+            # exactly rank r's target rows (zero-padded), so the even
+            # psum_scatter split lands each rank on its uneven share.
+            padded = np.zeros((gsize, chunk) + arr.shape[1:], arr.dtype)
+            for r in range(gsize):
+                padded[r, :counts[r]] = arr[starts[r]:starts[r] +
+                                            counts[r]]
+            prepped.append(padded.reshape((gsize * chunk,) +
+                                          arr.shape[1:]))
+            meta.append((was_jax, counts[my_idx]))
+        globals_ = [self._to_global(p, mesh, gsize)[0] for p in prepped]
+        fn = self._rs_fn(mesh, len(globals_), reduce_op)
+        outs = fn(*globals_)
+        results = []
+        for o, (was_jax, my_count) in zip(outs, meta):
+            mine = np.asarray(o.addressable_data(0))[0][:my_count]
+            results.append(jnp.asarray(mine) if was_jax else mine)
+        return results
+
+    def barrier(self, ps_ranks=()):
+        self.allreduce([np.zeros(1, np.float32)], "Sum", 1.0, 1.0,
+                       ps_ranks)
+        return None

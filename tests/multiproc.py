@@ -1,0 +1,83 @@
+"""Helper to run test bodies across N local worker processes.
+
+Mirrors the reference's test strategy of standing in N localhost
+processes for a cluster (SURVEY §4: every parallel test runs under
+``mpirun -np 2 -H localhost:2``).  Here the launcher env contract is set
+manually and workers are plain subprocesses; the controller rides TCP
+and the data plane rides gloo cross-process CPU collectives — the same
+code path as a TPU pod minus the hardware.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+RANK = hvd.rank()
+SIZE = hvd.size()
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
+                extra_env: Optional[dict] = None
+                ) -> List[Tuple[int, str]]:
+    """Run ``body`` (dedented python source, sees RANK/SIZE/np/hvd/jax)
+    in ``nproc`` worker processes.  Returns [(returncode, output)].
+    """
+    coord_port = free_port()
+    ctrl_port = free_port()
+    code = _PRELUDE + textwrap.dedent(body)
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(nproc),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(nproc),
+            "HOROVOD_CROSS_RANK": "0",
+            "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_TPU_COORDINATOR": f"127.0.0.1:{coord_port}",
+            "HOROVOD_CONTROLLER_ADDR": f"127.0.0.1:{ctrl_port}",
+            "HOROVOD_TPU_FORCE_CPU": "1",
+            "PYTHONPATH": REPO,
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    results = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            results.append((-9, out.decode(errors="replace")))
+            continue
+        results.append((p.returncode, out.decode(errors="replace")))
+    return results
+
+
+def assert_all_ok(results: List[Tuple[int, str]]):
+    for i, (rc, out) in enumerate(results):
+        assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-3000:]}"

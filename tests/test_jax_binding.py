@@ -1,0 +1,109 @@
+"""JAX binding tests (reference analog: test_tensorflow.py
+DistributedOptimizer / broadcast tests at np=1 + object collectives)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.ops.compression import Compression
+
+
+@pytest.fixture
+def hvd_jax():
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def test_allreduce_gradients_tree(hvd_jax):
+    grads = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+    out = hvd.allreduce_gradients(grads, name_prefix="g1")
+    assert set(out.keys()) == {"w", "b"}
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones((3, 2)))
+
+
+def test_distributed_optimizer_converges(hvd_jax):
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), name_prefix="do1")
+    params = {"w": jnp.zeros((4,))}
+    target = jnp.array([1.0, -1.0, 2.0, 0.5])
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((p["w"] - target) ** 2)
+
+    for _ in range(300):
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               np.asarray(target), atol=1e-3)
+
+
+def test_distributed_optimizer_backward_passes(hvd_jax):
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                  backward_passes_per_step=2,
+                                  name_prefix="do2")
+    params = jnp.zeros((2,))
+    opt_state = tx.init(params)
+    g = jnp.ones((2,))
+    updates, opt_state = tx.update(g, opt_state, params)
+    # First call: accumulated, zero update applied.
+    np.testing.assert_allclose(np.asarray(updates), 0.0)
+    updates, opt_state = tx.update(3 * g, opt_state, params)
+    # Second call: mean of (1, 3) = 2, sgd lr 1.0 → -2.
+    np.testing.assert_allclose(np.asarray(updates), -2.0)
+
+
+def test_distributed_optimizer_fp16_compression(hvd_jax):
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                  compression=Compression.fp16,
+                                  name_prefix="do3")
+    params = jnp.ones((4,))
+    opt_state = tx.init(params)
+    updates, _ = tx.update(jnp.full((4,), 0.5), opt_state, params)
+    assert updates.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(updates), -0.05)
+
+
+def test_broadcast_parameters(hvd_jax):
+    params = {"layer": {"w": jnp.arange(6.0).reshape(2, 3),
+                        "b": jnp.ones((3,))}}
+    out = hvd.broadcast_parameters(params, root_rank=0,
+                                   name_prefix="bp1")
+    np.testing.assert_allclose(np.asarray(out["layer"]["w"]),
+                               np.arange(6.0).reshape(2, 3))
+
+
+def test_broadcast_object(hvd_jax):
+    obj = {"epoch": 3, "lr": 0.1, "name": "résnet"}
+    out = hvd.broadcast_object(obj, root_rank=0, name="bo1")
+    assert out == obj
+
+
+def test_allgather_object(hvd_jax):
+    out = hvd.allgather_object({"rank": hvd.rank()}, name="ao1")
+    assert out == [{"rank": 0}]
+
+
+def test_metric_average(hvd_jax):
+    assert hvd.metric_average(4.0, "m1") == 4.0
+
+
+def test_compression_roundtrip():
+    x = np.random.randn(16).astype(np.float32)
+    c, ctx = Compression.fp16.compress(x)
+    assert c.dtype == np.float16
+    r = Compression.fp16.decompress(c, ctx)
+    assert r.dtype == np.float32
+    np.testing.assert_allclose(r, x, atol=1e-3)
+    xb = jnp.asarray(x)
+    c, ctx = Compression.bf16.compress(xb)
+    assert c.dtype == jnp.bfloat16
+    r = Compression.bf16.decompress(c, ctx)
+    assert r.dtype == jnp.float32
+    c, ctx = Compression.none.compress(x)
+    assert ctx is None and c is x

@@ -1,0 +1,141 @@
+"""Join, process sets, and fused-allgather regression tests over real
+worker processes (scenarios from code review: JOIN name matching, join
+re-fire of pending tensors, barrier name divergence, process-set
+required counts, per-tensor fused allgather sizes)."""
+
+import numpy as np
+import pytest
+
+from multiproc import assert_all_ok, run_workers
+
+pytestmark = pytest.mark.multiproc
+
+
+def test_join_basic_2proc():
+    results = run_workers("""
+        last = hvd.join()
+        print("JOINED", last)
+    """, nproc=2)
+    assert_all_ok(results)
+    for rc, out in results:
+        assert "JOINED" in out
+
+
+def test_join_substitutes_zeros_2proc():
+    # Rank 1 joins early; rank 0 keeps reducing — gets its own value
+    # (plus zeros from the joined rank).
+    results = run_workers("""
+        if RANK == 1:
+            last = hvd.join()
+            print("JOINED", last)
+        else:
+            x = np.full((4,), 5.0, np.float32)
+            y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="t"))
+            np.testing.assert_allclose(y, 5.0)
+            y2 = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="t2"))
+            np.testing.assert_allclose(y2, 5.0)
+            last = hvd.join()
+            print("JOINED", last)
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_join_refires_pending_2proc():
+    # Rank 1 submits an allreduce BEFORE rank 0 joins: the pending
+    # tensor must complete once rank 0's join arrives.
+    results = run_workers("""
+        import time
+        if RANK == 1:
+            x = np.ones((3,), np.float32)
+            y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="t"))
+            np.testing.assert_allclose(y, 1.0)
+            hvd.join()
+        else:
+            time.sleep(1.0)
+            hvd.join()
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_barrier_skewed_arrival_2proc():
+    # Barriers must match even when ranks arrive far apart and when one
+    # rank ran extra *named* collectives first (auto-name counters no
+    # longer participate in barrier naming).
+    results = run_workers("""
+        import time
+        if RANK == 0:
+            hvd.allreduce(np.ones(2, np.float32), name="extra0")
+            hvd.allreduce(np.ones(2, np.float32), name="extra1")
+        else:
+            time.sleep(1.5)
+            hvd.allreduce(np.ones(2, np.float32), name="extra0")
+            hvd.allreduce(np.ones(2, np.float32), name="extra1")
+        hvd.barrier()
+        hvd.barrier()
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_process_set_allreduce_4proc():
+    results = run_workers("""
+        ps = hvd.add_process_set([0, 2])
+        if RANK in (0, 2):
+            x = np.ones((4,), np.float32) * (RANK + 1)
+            y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="pst",
+                                         process_set=ps))
+            np.testing.assert_allclose(y, 4.0)  # ranks 0+2 -> 1+3
+        # everyone still does a global one afterwards
+        g = np.asarray(hvd.allreduce(np.ones(2, np.float32),
+                                     op=hvd.Sum, name="gl"))
+        np.testing.assert_allclose(g, 4.0)
+        print("OK")
+    """, nproc=4)
+    assert_all_ok(results)
+
+
+def test_fused_allgather_distinct_sizes_2proc():
+    # Two same-dtype allgathers with different per-rank rows submitted
+    # in one group → fused into one response; each must keep its own
+    # per-rank sizes.
+    results = run_workers("""
+        a = np.full((2 + RANK, 2), 1.0, np.float32)   # rows [2, 3]
+        b = np.full((4 - RANK, 2), 2.0, np.float32)   # rows [4, 3]
+        ha = hvd.allgather_async(a, name="fa")
+        hb = hvd.allgather_async(b, name="fb")
+        ya = np.asarray(hvd.synchronize(ha))
+        yb = np.asarray(hvd.synchronize(hb))
+        assert ya.shape == (5, 2), ya.shape
+        assert yb.shape == (7, 2), yb.shape
+        np.testing.assert_allclose(ya, 1.0)
+        np.testing.assert_allclose(yb, 2.0)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_unsigned_min_2proc():
+    results = run_workers("""
+        x = np.array([0, 5], np.uint8) if RANK == 0 else \
+            np.array([5, 3], np.uint8)
+        y = np.asarray(hvd.allreduce(x, op=hvd.Min, name="umin"))
+        np.testing.assert_array_equal(y, [0, 3])
+        z = np.asarray(hvd.allreduce(x, op=hvd.Max, name="umax"))
+        np.testing.assert_array_equal(z, [5, 5])
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_reducescatter_uneven_3proc():
+    results = run_workers("""
+        x = np.arange(7, dtype=np.float32).reshape(7, 1) * (RANK + 1)
+        y = np.asarray(hvd.reducescatter(x, name="rs"))
+        full = np.arange(7, dtype=np.float32).reshape(7, 1) * 6  # 1+2+3
+        bounds = {0: (0, 3), 1: (3, 5), 2: (5, 7)}
+        lo, hi = bounds[RANK]
+        np.testing.assert_allclose(y, full[lo:hi])
+        print("OK")
+    """, nproc=3)
+    assert_all_ok(results)

@@ -1,0 +1,166 @@
+"""Distributed correctness tests over real worker processes
+(reference analog: test/parallel/* run under mpirun -np 2)."""
+
+import numpy as np
+import pytest
+
+from multiproc import assert_all_ok, run_workers
+
+pytestmark = pytest.mark.multiproc
+
+
+def test_allreduce_2proc():
+    results = run_workers("""
+        x = np.ones((4,), np.float32) * (RANK + 1)
+        y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="t"))
+        np.testing.assert_allclose(y, np.full((4,), 3.0))
+        a = np.asarray(hvd.allreduce(x, op=hvd.Average, name="t2"))
+        np.testing.assert_allclose(a, np.full((4,), 1.5))
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_allreduce_minmax_prescale_2proc():
+    results = run_workers("""
+        x = np.arange(4, dtype=np.float32) * (RANK + 1)
+        mn = np.asarray(hvd.allreduce(x, op=hvd.Min, name="mn"))
+        mx = np.asarray(hvd.allreduce(x, op=hvd.Max, name="mx"))
+        np.testing.assert_allclose(mn, np.arange(4, dtype=np.float32))
+        np.testing.assert_allclose(mx, np.arange(4, dtype=np.float32) * 2)
+        s = np.asarray(hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                                     name="ps"))
+        np.testing.assert_allclose(s, np.arange(4, dtype=np.float32) * 6)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_grouped_allreduce_2proc():
+    results = run_workers("""
+        xs = [np.full((3,), float(RANK + i), np.float32) for i in range(4)]
+        ys = hvd.grouped_allreduce(xs, op=hvd.Sum, name="g")
+        for i, y in enumerate(ys):
+            np.testing.assert_allclose(
+                np.asarray(y), np.full((3,), 2.0 * i + 1.0))
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_allgather_2proc_uneven():
+    results = run_workers("""
+        rows = 2 if RANK == 0 else 3
+        x = np.full((rows, 2), float(RANK), np.float32)
+        y = np.asarray(hvd.allgather(x, name="ag"))
+        assert y.shape == (5, 2), y.shape
+        np.testing.assert_allclose(y[:2], 0.0)
+        np.testing.assert_allclose(y[2:], 1.0)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_broadcast_2proc():
+    results = run_workers("""
+        x = np.arange(6, dtype=np.float64) * (RANK + 1)
+        y = np.asarray(hvd.broadcast(x, root_rank=1, name="b"))
+        np.testing.assert_allclose(y, np.arange(6, dtype=np.float64) * 2)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_alltoall_2proc():
+    results = run_workers("""
+        # rank0 sends [0,1] to r0, [2,3,4] to r1; rank1 sends [10] to
+        # r0, [11,12] to r1
+        if RANK == 0:
+            x = np.array([0, 1, 2, 3, 4], np.float32)
+            splits = np.array([2, 3])
+        else:
+            x = np.array([10, 11, 12], np.float32)
+            splits = np.array([1, 2])
+        y, recv = hvd.alltoall(x, splits=splits, name="a2a")
+        y = np.asarray(y)
+        if RANK == 0:
+            np.testing.assert_allclose(y, [0, 1, 10])
+            np.testing.assert_allclose(np.asarray(recv), [2, 1])
+        else:
+            np.testing.assert_allclose(y, [2, 3, 4, 11, 12])
+            np.testing.assert_allclose(np.asarray(recv), [3, 2])
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_reducescatter_2proc():
+    results = run_workers("""
+        x = np.arange(6, dtype=np.float32).reshape(6, 1) * (RANK + 1)
+        y = np.asarray(hvd.reducescatter(x, name="rs"))
+        full = np.arange(6, dtype=np.float32).reshape(6, 1) * 3
+        expect = full[:3] if RANK == 0 else full[3:]
+        np.testing.assert_allclose(y, expect)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_barrier_and_shape_mismatch_error_2proc():
+    results = run_workers("""
+        hvd.barrier()
+        # Mismatched shapes must produce a coordinator error on all ranks
+        import horovod_tpu
+        x = np.ones((2 + RANK,), np.float32)
+        try:
+            hvd.allreduce(x, name="bad")
+            print("NOERROR")
+        except Exception as e:
+            print("GOT_ERROR", type(e).__name__)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+    for rc, out in results:
+        assert "GOT_ERROR" in out, out
+
+
+def test_adasum_2proc():
+    results = run_workers("""
+        from horovod_tpu.ops.adasum import adasum_reference_numpy
+        a = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        b = np.array([4.0, 3.0, 2.0, 1.0], np.float32)
+        mine = a if RANK == 0 else b
+        y = np.asarray(hvd.allreduce(mine, op=hvd.Adasum, name="ad"))
+        expect = adasum_reference_numpy([a, b])
+        np.testing.assert_allclose(y, expect, rtol=1e-5)
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_jax_binding_2proc():
+    results = run_workers("""
+        import jax.numpy as jnp
+        import horovod_tpu.jax as hj
+        params = {"w": jnp.ones((3,)) * (RANK + 1), "b": jnp.zeros(2)}
+        out = hj.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+        obj = hj.broadcast_object({"x": RANK}, root_rank=1)
+        assert obj == {"x": 1}
+        objs = hj.allgather_object(RANK * 10)
+        assert objs == [0, 10]
+        m = hj.metric_average(float(RANK), "m")
+        assert m == 0.5
+        print("OK")
+    """, nproc=2)
+    assert_all_ok(results)
+
+
+def test_allreduce_4proc():
+    results = run_workers("""
+        x = np.ones((8,), np.float32) * (RANK + 1)
+        y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="t"))
+        np.testing.assert_allclose(y, np.full((8,), 10.0))
+        print("OK")
+    """, nproc=4)
+    assert_all_ok(results)
