@@ -277,7 +277,11 @@ class NetworkController(Controller):
         self._send_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
-        deadline = time.monotonic() + 120.0
+        # HOROVOD_START_TIMEOUT bounds the wait for the coordinator to
+        # come up (launcher --start-timeout; reference launch.py
+        # start_timeout contract).
+        timeout_s = float(os.environ.get("HOROVOD_START_TIMEOUT", 120))
+        deadline = time.monotonic() + timeout_s
         last_err = None
         while time.monotonic() < deadline:
             try:

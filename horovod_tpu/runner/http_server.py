@@ -1,0 +1,220 @@
+"""Rendezvous: threaded HTTP key-value store + client.
+
+The control-plane rendezvous service the launcher runs on the driver
+host (reference: runner/http/http_server.py:35-204 ``KVStoreHandler`` /
+``RendezvousServer``).  Workers and driver communicate through scoped
+keys:
+
+    PUT  /scope/key     store a value
+    GET  /scope/key     fetch (404 until present)
+    DELETE /scope       finalize a scope (elastic: signal re-rendezvous)
+
+Values are opaque bytes.  The elastic driver plugs in an extended
+handler that answers ``GET /rank_and_size/<hostname>:<local_rank>``
+from live host assignments (reference:
+runner/elastic/rendezvous.py:28-55).
+"""
+
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.error import HTTPError
+from urllib.request import Request as UrlRequest, urlopen
+
+logger = logging.getLogger("horovod_tpu.rendezvous")
+
+OK = 200
+NOT_FOUND = 404
+BAD_REQUEST = 400
+
+
+class KVStore:
+    """Scoped in-memory KV store shared by all handler threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, bytes]] = {}
+        self._finalized: Dict[str, bool] = {}
+
+    def put(self, scope: str, key: str, value: bytes):
+        with self._lock:
+            self._data.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(scope, {}).get(key)
+
+    def keys(self, scope: str):
+        with self._lock:
+            return list(self._data.get(scope, {}).keys())
+
+    def finalize(self, scope: str):
+        with self._lock:
+            self._finalized[scope] = True
+
+    def is_finalized(self, scope: str) -> bool:
+        with self._lock:
+            return self._finalized.get(scope, False)
+
+
+class KVStoreHandler(BaseHTTPRequestHandler):
+    """Routes /scope/key to the server's KVStore.  Subclasses may
+    override ``handle_get_special`` to serve computed scopes."""
+    protocol_version = "HTTP/1.1"
+
+    def _split(self) -> Optional[Tuple[str, str]]:
+        parts = self.path.lstrip("/").split("/", 1)
+        if len(parts) == 1:
+            return parts[0], ""
+        return parts[0], parts[1]
+
+    def handle_get_special(self, scope: str, key: str) -> Optional[bytes]:
+        return None
+
+    def do_GET(self):
+        scope, key = self._split()
+        special = self.handle_get_special(scope, key)
+        value = special if special is not None \
+            else self.server.kvstore.get(scope, key)
+        if value is None:
+            self.send_response(NOT_FOUND)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(OK)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        self.server.kvstore.put(scope, key, value)
+        self.send_response(OK)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        scope, _ = self._split()
+        self.server.kvstore.finalize(scope)
+        self.send_response(OK)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # quiet by default
+        logger.debug("rendezvous: " + fmt, *args)
+
+
+class RendezvousServer:
+    """Threaded HTTP KV server; ``start()`` returns the bound port."""
+
+    def __init__(self, verbose: int = 0,
+                 handler_cls=KVStoreHandler, port: int = 0):
+        self._verbose = verbose
+        self._handler_cls = handler_cls
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def kvstore(self) -> Optional[KVStore]:
+        return self._httpd.kvstore if self._httpd else None
+
+    def start(self, handler_cls=None) -> int:
+        cls = handler_cls or self._handler_cls
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", self._requested_port), cls)
+        self._httpd.kvstore = KVStore()
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="hvd-rendezvous", daemon=True)
+        self._thread.start()
+        port = self._httpd.server_address[1]
+        logger.debug("rendezvous server listening on %d", port)
+        return port
+
+    # Elastic swaps assignments without restarting the server.
+    def init(self, host_assignments=None):
+        if self._httpd is not None:
+            self._httpd.host_assignments = host_assignments or {}
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class RendezvousClient:
+    """Tiny blocking HTTP client for the KV store."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 30.0):
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes):
+        req = UrlRequest(f"{self._base}/{scope}/{key}", data=value,
+                         method="PUT")
+        with urlopen(req, timeout=self._timeout):
+            pass
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        try:
+            with urlopen(f"{self._base}/{scope}/{key}",
+                         timeout=self._timeout) as r:
+                return r.read()
+        except HTTPError as e:
+            if e.code == NOT_FOUND:
+                return None
+            raise
+
+    def wait_get(self, scope: str, key: str,
+                 timeout: float = 120.0) -> bytes:
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            time.sleep(0.1)
+        raise TimeoutError(f"rendezvous key {scope}/{key} never appeared")
+
+    def delete(self, scope: str):
+        req = UrlRequest(f"{self._base}/{scope}/", method="DELETE")
+        with urlopen(req, timeout=self._timeout):
+            pass
+
+
+def find_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def local_addresses():
+    """Best-effort list of this host's non-loopback IPv4 addresses."""
+    addrs = set()
+    try:
+        hostname = socket.gethostname()
+        for info in socket.getaddrinfo(hostname, None, socket.AF_INET):
+            addrs.add(info[4][0])
+    except socket.gaierror:
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        addrs.add(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    addrs.discard("127.0.0.1")
+    return sorted(addrs) or ["127.0.0.1"]

@@ -1,0 +1,71 @@
+"""TPU pod topology discovery from VM instance metadata.
+
+Replaces the reference's ssh/hostfile host discovery with the TPU-native
+source of truth: on a Cloud TPU VM, the GCE metadata server exposes the
+slice's worker hostnames and the accelerator topology
+(``worker-network-endpoints``, ``accelerator-type``).  Off-TPU (or when
+metadata is unreachable) callers fall back to explicit ``-H`` lists.
+
+This module has zero hard dependencies: it degrades to environment
+variables (``TPU_WORKER_HOSTNAMES``) and then to nothing.
+"""
+
+import logging
+import os
+from typing import List, Optional
+
+logger = logging.getLogger("horovod_tpu.tpu_metadata")
+
+_METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                 "instance/attributes/{}")
+
+# Env fallbacks set by TPU runtimes / users.
+TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"      # comma-separated
+TPU_WORKER_ID = "TPU_WORKER_ID"
+TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"      # e.g. "v5e-256"
+
+
+def _metadata_get(key: str, timeout: float = 1.0) -> Optional[str]:
+    from urllib.request import Request, urlopen
+    try:
+        req = Request(_METADATA_URL.format(key),
+                      headers={"Metadata-Flavor": "Google"})
+        with urlopen(req, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def worker_hostnames() -> List[str]:
+    """Hostnames/IPs of all TPU-VM workers of this slice, index-ordered."""
+    env = os.environ.get(TPU_WORKER_HOSTNAMES)
+    if env:
+        return [h.strip() for h in env.split(",") if h.strip()]
+    raw = _metadata_get("worker-network-endpoints")
+    if raw:
+        # Format: "ip:port:...,ip:port:..." per worker; first field is
+        # the routable IP.
+        return [entry.split(":")[0] for entry in raw.split(",") if entry]
+    return []
+
+
+def worker_id() -> int:
+    env = os.environ.get(TPU_WORKER_ID)
+    if env is not None:
+        return int(env)
+    raw = _metadata_get("agent-worker-number")
+    return int(raw) if raw else 0
+
+
+def accelerator_type() -> Optional[str]:
+    return os.environ.get(TPU_ACCELERATOR_TYPE) or \
+        _metadata_get("accelerator-type")
+
+
+def discover_pod_hosts(slots: int = 1) -> Optional[str]:
+    """Return a ``host:slots`` list for the current TPU slice, or None
+    when no pod metadata is available."""
+    hosts = worker_hostnames()
+    if not hosts:
+        return None
+    return ",".join(f"{h}:{slots}" for h in hosts)

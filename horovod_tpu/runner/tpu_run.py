@@ -1,0 +1,276 @@
+"""Static launch: spawn one worker process per slot.
+
+The TPU analog of the reference's Gloo launcher (reference:
+runner/gloo_run.py:226-273 ``launch_gloo``): compute the slot plan,
+start the rendezvous KV server on the driver, then exec the training
+command once per slot — locally via a subprocess, remotely via ssh —
+with the full rank env contract.  There is no MPI path: the control
+plane is TCP/HTTP over DCN, the data plane is XLA collectives over
+ICI/DCN once workers call ``hvd.init()``.
+
+Worker env contract per slot (beyond the rank vars of
+``hosts.slot_env_vars``):
+
+    HOROVOD_GLOO_RENDEZVOUS_ADDR / _PORT   driver KV store
+    HOROVOD_TPU_COORDINATOR                jax.distributed coordinator
+                                           (rank-0 host:port)
+    HOROVOD_CONTROLLER_ADDR                rank-0 negotiation TCP server
+    HOROVOD_CONTROLLER=tcp                 controller kind
+"""
+
+import functools
+import logging
+import os
+import shlex
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import safe_shell_exec
+from .hosts import SlotInfo, get_host_assignments, parse_hosts, \
+    slot_env_vars
+from .http_server import RendezvousServer, find_port, local_addresses
+
+logger = logging.getLogger("horovod_tpu.run")
+
+# A pre-provisioned rendezvous port, for schedulers that must know ports
+# up front (reference: the Determined fork's
+# PEDL_HOROVOD_GLOO_RENDEZVOUS_PORT hook, runner/gloo_run.py:250).
+PREPROVISIONED_PORT_ENV = "HOROVOD_TPU_RENDEZVOUS_PORT"
+
+_LOCAL_HOSTNAMES = ("localhost", "127.0.0.1")
+
+
+@functools.lru_cache(maxsize=1)
+def _local_addresses_cached():
+    return tuple(local_addresses())
+
+
+def is_local(hostname: str) -> bool:
+    import socket
+    return hostname in _LOCAL_HOSTNAMES or \
+        hostname == socket.gethostname() or \
+        hostname in _local_addresses_cached()
+
+
+def _ssh_command(hostname: str, command: str, ssh_port: Optional[int],
+                 ssh_identity_file: Optional[str]) -> str:
+    opts = "-o StrictHostKeyChecking=no -o BatchMode=yes"
+    if ssh_port:
+        opts += f" -p {ssh_port}"
+    if ssh_identity_file:
+        opts += f" -i {shlex.quote(ssh_identity_file)}"
+    return f"ssh {opts} {hostname} {shlex.quote(command)}"
+
+
+def _exportable(key: str, value: str) -> bool:
+    return not key.startswith("BASH_FUNC_") and key != "LS_COLORS" and \
+        "\n" not in value and key != "_"
+
+
+def slot_command(run_command: str, slot: SlotInfo, env: Dict[str, str],
+                 common_env: Dict[str, str]) -> str:
+    """Build the full shell line for one slot (env assignments inlined
+    so the contract survives the ssh hop, reference gloo_run.py:79-101).
+    """
+    slot_env = dict(common_env)
+    slot_env.update(slot_env_vars(slot))
+    slot_env["PYTHONUNBUFFERED"] = "1"
+    assigns = " ".join(f"{k}={shlex.quote(str(v))}"
+                       for k, v in slot_env.items())
+    fwd = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
+                   if _exportable(k, v) and k not in slot_env)
+    return f"{assigns} {fwd} {run_command}"
+
+
+class WorkerResults:
+    """Collects per-slot exit codes; any non-zero marks failure."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._codes: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.any_failed = threading.Event()
+
+    def record(self, rank: int, code: int):
+        with self._lock:
+            self._codes[rank] = code
+        if code != 0:
+            self.any_failed.set()
+
+    @property
+    def exit_codes(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._codes)
+
+
+def launch_static(command: List[str],
+                  hosts: str,
+                  np: int,
+                  env: Optional[Dict[str, str]] = None,
+                  ssh_port: Optional[int] = None,
+                  ssh_identity_file: Optional[str] = None,
+                  output_filename: Optional[str] = None,
+                  verbose: int = 0,
+                  server_ip: Optional[str] = None,
+                  kill_all_on_failure: bool = True,
+                  extra_worker_env: Optional[Dict[str, str]] = None,
+                  start_timeout: Optional[int] = None,
+                  ) -> Dict[int, int]:
+    """Run ``command`` on ``np`` slots of ``hosts``; block until all
+    workers exit.  Returns {rank: exit_code}."""
+    host_infos = parse_hosts(hosts)
+    slots = get_host_assignments(host_infos, np, np)
+    rank0_host = slots[0].hostname
+
+    requested = int(os.environ.get(PREPROVISIONED_PORT_ENV, 0))
+    server = RendezvousServer(verbose, port=requested)
+    rendezvous_port = server.start()
+    server.init({})
+
+    all_local = all(is_local(s.hostname) for s in slots)
+    driver_ip = server_ip or (
+        "127.0.0.1" if all_local else local_addresses()[0])
+    # Rank 0 hosts the jax.distributed coordinator and the negotiation
+    # TCP server; remote workers need a routable address for it.  When
+    # rank 0 runs on the driver host, the driver's routable IP is that
+    # address; otherwise the (remote) hostname itself is.
+    if is_local(rank0_host):
+        rank0_addr = "127.0.0.1" if all_local else driver_ip
+    else:
+        rank0_addr = rank0_host
+
+    coordinator_port = find_port()
+    controller_port = find_port()
+    common_env = {
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
+        "HOROVOD_CONTROLLER": "tcp",
+        "HOROVOD_TPU_COORDINATOR": f"{rank0_addr}:{coordinator_port}",
+        "HOROVOD_CONTROLLER_ADDR": f"{rank0_addr}:{controller_port}",
+    }
+    if start_timeout:
+        # Bounds how long workers wait for each other at init
+        # (consumed by the controller's connect loop).
+        common_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
+    if extra_worker_env:
+        common_env.update(extra_worker_env)
+
+    run_command = " ".join(shlex.quote(c) for c in command)
+    results = WorkerResults(len(slots))
+    events = [results.any_failed] if kill_all_on_failure else []
+
+    def _run_slot(slot: SlotInfo):
+        cmd = slot_command(run_command, slot, env or dict(os.environ),
+                           common_env)
+        if not is_local(slot.hostname):
+            cmd = _ssh_command(slot.hostname, cmd, ssh_port,
+                               ssh_identity_file)
+        stdout = stderr = None
+        if output_filename:
+            d = os.path.join(output_filename, f"rank.{slot.rank}")
+            os.makedirs(d, exist_ok=True)
+            stdout = open(os.path.join(d, "stdout"), "w")
+            stderr = open(os.path.join(d, "stderr"), "w")
+        if verbose:
+            logger.info("launching rank %d on %s", slot.rank,
+                        slot.hostname)
+        try:
+            code = safe_shell_exec.execute(
+                cmd, stdout=stdout, stderr=stderr, index=slot.rank,
+                events=events)
+        finally:
+            for f in (stdout, stderr):
+                if f:
+                    f.close()
+        results.record(slot.rank, code)
+
+    threads = [threading.Thread(target=_run_slot, args=(s,), daemon=True)
+               for s in slots]
+    start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    codes = results.exit_codes
+    if verbose:
+        logger.info("all workers finished in %.1fs: %s",
+                    time.monotonic() - start, codes)
+    failed = {r: c for r, c in codes.items() if c != 0}
+    if failed:
+        raise RuntimeError(
+            "Horovod run failed: non-zero exit codes %s" % failed)
+    return codes
+
+
+# ---------------------------------------------------------------------------
+# programmatic run(): ship a pickled function, collect per-rank results
+# (reference: runner/__init__.py:91-206 + launch.py:604-623 run_func)
+# ---------------------------------------------------------------------------
+_FUNC_SCOPE = "runfunc"
+
+
+def _worker_main():
+    """Entry executed by every slot of a ``run(func)`` launch."""
+    import cloudpickle
+    from .http_server import RendezvousClient
+    addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"])
+    rank = int(os.environ["HOROVOD_RANK"])
+    client = RendezvousClient(addr, port)
+    func = cloudpickle.loads(client.wait_get(_FUNC_SCOPE, "func"))
+    result = func()
+    client.put(_FUNC_SCOPE, f"result_{rank}", cloudpickle.dumps(result))
+
+
+def run_func(func: Callable, hosts: str, np: int,
+             env: Optional[Dict[str, str]] = None,
+             verbose: int = 0, use_mpi=None, use_gloo=None,
+             **kwargs) -> List:
+    """Run ``func()`` on every rank; return results ordered by rank."""
+    import cloudpickle
+    from .http_server import RendezvousClient
+
+    host_infos = parse_hosts(hosts)
+    slots = get_host_assignments(host_infos, np, np)
+
+    server = RendezvousServer(verbose)
+    rendezvous_port = server.start()
+    server.init({})
+    driver_ip = "127.0.0.1" if all(is_local(s.hostname) for s in slots) \
+        else local_addresses()[0]
+    client = RendezvousClient(driver_ip, rendezvous_port)
+    client.put(_FUNC_SCOPE, "func", cloudpickle.dumps(func))
+
+    command = [sys.executable, "-m", "horovod_tpu.runner.tpu_run"]
+    worker_env = dict(env or os.environ)
+    worker_env.setdefault("PYTHONPATH", os.pathsep.join(sys.path))
+    try:
+        # The static launcher runs its own rendezvous server for worker
+        # coordination; results flow through ours.
+        launch_static(command, hosts, np, env=worker_env,
+                      verbose=verbose,
+                      extra_worker_env={
+                          "HOROVOD_RUNFUNC_ADDR": driver_ip,
+                          "HOROVOD_RUNFUNC_PORT": str(rendezvous_port)},
+                      **kwargs)
+        results = []
+        for slot in slots:
+            raw = client.wait_get(_FUNC_SCOPE, f"result_{slot.rank}",
+                                  timeout=30.0)
+            results.append(cloudpickle.loads(raw))
+        return results
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    # `python -m horovod_tpu.runner.tpu_run` = run_func worker entry.
+    if "HOROVOD_RUNFUNC_ADDR" in os.environ:
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = \
+            os.environ["HOROVOD_RUNFUNC_ADDR"]
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = \
+            os.environ["HOROVOD_RUNFUNC_PORT"]
+    _worker_main()
