@@ -1,0 +1,109 @@
+"""Shared Keras implementation used by ``horovod_tpu.keras`` and
+``horovod_tpu.tensorflow.keras`` (reference: horovod/_keras/__init__.py
+— create_distributed_optimizer via dynamic subclassing, broadcast
+helpers).
+
+Built against Keras 3 (``tf.keras`` is Keras 3 in TF ≥ 2.16): the
+override point is ``apply_gradients``, which every backend's train step
+calls.  Gradients stage through host memory into the background
+runtime, matching the TF binding's design.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..common import basics
+from ..common.basics import Average, Sum, global_process_set
+from .. import ops as _ops
+from ..ops.compression import Compression
+
+
+def create_distributed_optimizer(optimizer, name=None,
+                                 compression=Compression.none,
+                                 sparse_as_dense=False,
+                                 backward_passes_per_step=1, op=Average,
+                                 gradient_predivide_factor=1.0,
+                                 average_aggregated_gradients=False,
+                                 num_groups=None,
+                                 process_set=global_process_set,
+                                 make_allreduce_grads_fn=None):
+    if make_allreduce_grads_fn is None:
+        from ..tensorflow import _make_allreduce_grads_fn as _fn
+        make_allreduce_grads_fn = _fn
+    allreduce_grads = make_allreduce_grads_fn(
+        name or "DistributedOptimizer", "", "", compression,
+        sparse_as_dense, op, gradient_predivide_factor, num_groups,
+        process_set)
+
+    cls = optimizer.__class__
+
+    class _DistributedOptimizer(cls):
+        _hvd_distributed = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            import tensorflow as tf
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            variables = [v for _, v in grads_and_vars]
+            if self._hvd_backward_passes > 1:
+                if not tf.executing_eagerly():
+                    raise NotImplementedError(
+                        "backward_passes_per_step > 1 requires eager "
+                        "execution (compile with run_eagerly=True); the "
+                        "compiled-path equivalent lives in "
+                        "horovod_tpu.jax / horovod_tpu.training.")
+                grads = self._hvd_accumulate(grads)
+                if grads is None:
+                    return None
+            reduced = self._hvd_allreduce_grads(grads, variables)
+            return super().apply_gradients(
+                zip(reduced, variables), *args, **kwargs)
+
+        def _hvd_accumulate(self, grads):
+            acc = self.__dict__.setdefault("_hvd_acc", None)
+            n = self.__dict__.setdefault("_hvd_count", 0) + 1
+            if acc is None:
+                acc = [np.array(g) for g in grads]
+            else:
+                acc = [a + np.array(g) for a, g in zip(acc, grads)]
+            if n < self._hvd_backward_passes:
+                self.__dict__["_hvd_acc"] = acc
+                self.__dict__["_hvd_count"] = n
+                return None
+            self.__dict__["_hvd_acc"] = None
+            self.__dict__["_hvd_count"] = 0
+            scale = (self._hvd_backward_passes
+                     if self._hvd_average_aggregated else 1)
+            return [a / scale for a in acc]
+
+    dist_name = name or "Distributed" + cls.__name__
+    _DistributedOptimizer.__name__ = dist_name
+    new_opt = _DistributedOptimizer.from_config(optimizer.get_config())
+    new_opt._hvd_allreduce_grads = allreduce_grads
+    new_opt._hvd_backward_passes = backward_passes_per_step
+    new_opt._hvd_average_aggregated = average_aggregated_gradients
+    # Carry over any state the optimizer had (slot variables are
+    # created lazily, so a freshly-configured clone is equivalent).
+    return new_opt
+
+
+def broadcast_variables(variables, root_rank: int,
+                        process_set=global_process_set):
+    for i, var in enumerate(variables):
+        name = getattr(var, "name", None) or f"bcast_var.{i}"
+        value = _ops.broadcast(np.asarray(var), root_rank,
+                               name=f"kbcast/{name}.{i}",
+                               process_set=process_set)
+        var.assign(np.asarray(value))
+
+
+def broadcast_model(model, root_rank: int,
+                    process_set=global_process_set):
+    weights = model.get_weights()
+    out = []
+    for i, w in enumerate(weights):
+        out.append(np.asarray(_ops.broadcast(
+            w, root_rank, name=f"kbcast_model/{i}",
+            process_set=process_set)))
+    model.set_weights(out)

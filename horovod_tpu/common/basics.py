@@ -148,8 +148,21 @@ def init(comm=None, process_sets=None):
     with state.init_lock:
         if state.initialized:
             return
-        state.rank_info = RankInfo.from_env()
         state.knobs = Knobs.from_env()
+        if state.knobs.elastic and \
+                os.environ.get(env_mod.HOROVOD_RENDEZVOUS_ADDR):
+            # Elastic worker: rank identity comes from the driver's
+            # rendezvous, fresh every epoch (reference:
+            # gloo/gloo_context.cc:154-200 elastic rank re-query).
+            from ..runner.elastic.worker import (
+                RendezvousHostUpdateSource, elastic_rendezvous)
+            from . import elastic as elastic_mod
+            info = elastic_rendezvous()
+            state.elastic_enabled = True
+            src = RendezvousHostUpdateSource(
+                seed_generation=int(info.get("generation", 0)))
+            elastic_mod.set_host_update_source(src)
+        state.rank_info = RankInfo.from_env()
 
         if comm is not None and not hasattr(comm, "Get_rank"):
             ranks = sorted(comm)
@@ -199,6 +212,25 @@ def shutdown():
             state.timeline.close()
             state.timeline = None
         state.backend = None
+        if state.distributed_client_owned:
+            # Tear down the jax.distributed client so a later init()
+            # can re-form the world with a different size (elastic
+            # reset; verified working on the gloo CPU path and on TPU
+            # via the coordination-service client restart).
+            import jax
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                logger.warning("jax.distributed.shutdown failed",
+                               exc_info=True)
+            try:
+                jax.clear_caches()
+                import jax.extend.backend as _jeb
+                _jeb.clear_backends()
+            except Exception:
+                logger.warning("clearing XLA backends failed",
+                               exc_info=True)
+            state.distributed_client_owned = False
         state.initialized = False
 
 

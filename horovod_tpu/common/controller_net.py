@@ -74,10 +74,14 @@ class CoordinatorServer:
 
     def __init__(self, size: int, bind_addr: str = "0.0.0.0",
                  port: int = 0, fusion_threshold: int = 64 << 20,
-                 timeline=None):
+                 timeline=None, elastic: bool = False,
+                 allow_ephemeral_fallback: bool = False):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
+        self.elastic = elastic
+        self.allow_ephemeral_fallback = allow_ephemeral_fallback
+        self._broken = False
         self._table = MessageTable()
         # tensor name -> element count, for fusion byte accounting
         self._elem_cache: Dict[str, int] = {}
@@ -90,7 +94,21 @@ class CoordinatorServer:
         self._stop = threading.Event()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((bind_addr, port))
+        try:
+            self._srv.bind((bind_addr, port))
+        except OSError:
+            if not self.allow_ephemeral_fallback:
+                # Without a rendezvous store to publish the real port,
+                # an ephemeral fallback would leave workers hanging on
+                # the dead env-contract port — fail crisply instead.
+                raise
+            # The launcher-chosen port got taken in the meantime; fall
+            # back to an ephemeral port.  The actual address is
+            # published through the rendezvous KV store, which workers
+            # prefer over the env contract.
+            logger.warning("controller port %d unavailable; using an "
+                           "ephemeral port", port)
+            self._srv.bind((bind_addr, 0))
         self._srv.listen(size + 4)
         self.port = self._srv.getsockname()[1]
         self._accept_thread = threading.Thread(
@@ -130,15 +148,61 @@ class CoordinatorServer:
             self._threads.append(t)
 
     def _rank_loop(self, rank: int, conn: socket.socket):
-        while not self._stop.is_set():
-            frame = _recv_frame(conn)
-            if frame is None:
-                return
-            _, payload = frame
-            requests, shutdown = unpack_request_list(payload)
-            if shutdown:
-                return
-            self._handle_requests(rank, requests)
+        clean = False
+        try:
+            while not self._stop.is_set():
+                try:
+                    frame = _recv_frame(conn)
+                except OSError:
+                    frame = None
+                if frame is None:
+                    return
+                _, payload = frame
+                requests, shutdown = unpack_request_list(payload)
+                if shutdown:
+                    clean = True
+                    return
+                self._handle_requests(rank, requests)
+        finally:
+            if not self._stop.is_set():
+                self._on_rank_lost(rank, clean)
+
+    def _on_rank_lost(self, rank: int, clean: bool):
+        """A rank departed mid-run.  In elastic mode, pending
+        negotiations can never complete: fail them on every surviving
+        rank so blocked synchronize() calls raise HorovodInternalError
+        and unwind to the elastic retry loop (the analog of the
+        reference's collective errors on peer failure,
+        common/exceptions.py:18 semantics)."""
+        if not self.elastic:
+            return
+        with self._lock:
+            self._conns.pop(rank, None)
+            self._broken = True
+            pending = list(self._table.entries.keys()) + \
+                list(self._barriers.keys())
+            self._table.entries.clear()
+            self._barriers.clear()
+            msg = (f"rank {rank} left the job "
+                   f"({'clean' if clean else 'connection lost'}); "
+                   "membership changed")
+            logger.info("elastic coordinator: %s", msg)
+            responses = [Response(
+                response_type=ResponseType.ERROR, tensor_names=[name],
+                error_message=msg) for name in pending]
+            if responses:
+                self._broadcast_locked(responses)
+
+    def _broadcast_locked(self, responses: List[Response]):
+        payload = pack_response_list(responses)
+        dead = []
+        for r, conn in self._conns.items():
+            try:
+                _send_frame(conn, _MAGIC_RESP, payload)
+            except OSError:
+                dead.append(r)
+        for r in dead:
+            self._conns.pop(r, None)
 
     @staticmethod
     def _required_for(req: Request) -> int:
@@ -170,6 +234,15 @@ class CoordinatorServer:
         ready (single-threaded per coordinator via the lock: ordering of
         broadcast frames is the global execution order)."""
         with self._lock:
+            if self._broken:
+                # Membership already changed this epoch: every new
+                # request fails fast so submitters unwind promptly.
+                self._broadcast_locked([Response(
+                    response_type=ResponseType.ERROR,
+                    tensor_names=[req.tensor_name],
+                    error_message="membership changed; collective "
+                                  "cannot complete") for req in requests])
+                return
             ready: List[Response] = []
             for req in requests:
                 n = 1
@@ -218,15 +291,7 @@ class CoordinatorServer:
                 return
             fused = fuse_responses(ready, self._elem_cache,
                                    self.fusion_threshold)
-            payload = pack_response_list(fused)
-            dead = []
-            for r, conn in self._conns.items():
-                try:
-                    _send_frame(conn, _MAGIC_RESP, payload)
-                except OSError:
-                    dead.append(r)
-            for r in dead:
-                self._conns.pop(r, None)
+            self._broadcast_locked(fused)
 
     def stop(self):
         self._stop.set()
@@ -251,6 +316,8 @@ class NetworkController(Controller):
     def __init__(self, state):
         super().__init__(state)
         self.server: Optional[CoordinatorServer] = None
+        self._closing = False
+        self._broken_err: Optional[Exception] = None
         addr = os.environ.get(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
@@ -259,15 +326,20 @@ class NetworkController(Controller):
             self.server = CoordinatorServer(
                 self.size, port=port,
                 fusion_threshold=state.knobs.fusion_threshold_bytes,
-                timeline=state.timeline)
+                timeline=state.timeline,
+                elastic=state.knobs.elastic,
+                allow_ephemeral_fallback=(
+                    self._rendezvous_client() is not None))
+            self._publish_actual_addr(addr, self.server.port)
             host = "127.0.0.1"
             self._addr = (host, self.server.port)
         else:
-            if not addr:
+            resolved = self._resolve_addr(addr)
+            if not resolved:
                 raise RuntimeError(
                     f"{CONTROLLER_ADDR_ENV} must be set for multi-process "
                     "runs (the launcher sets it automatically).")
-            host, port = addr.rsplit(":", 1)
+            host, port = resolved.rsplit(":", 1)
             self._addr = (host, int(port))
         self._sock = self._connect()
         self._recv_buf: "queue.Queue" = queue.Queue()
@@ -275,6 +347,51 @@ class NetworkController(Controller):
             target=self._recv_loop, name="hvd-ctrl-recv", daemon=True)
         self._recv_thread.start()
         self._send_lock = threading.Lock()
+
+    @staticmethod
+    def _rendezvous_client():
+        from ..runner.http_server import RendezvousClient
+        addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
+        port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+        if not addr or not port:
+            return None
+        return RendezvousClient(addr, int(port))
+
+    def _ctrl_scope(self) -> str:
+        # Per-epoch scope so elastic re-inits don't read a stale addr.
+        epoch = os.environ.get("HOROVOD_CONTROLLER_ADDR", "")
+        return f"controller.{epoch}"
+
+    def _publish_actual_addr(self, env_addr, actual_port):
+        """Rank 0: publish the actually-bound controller address to the
+        rendezvous KV store (guards against the launcher-chosen port
+        being taken by the time rank 0 binds it)."""
+        client = self._rendezvous_client()
+        if client is None:
+            return
+        host = env_addr.rsplit(":", 1)[0] if env_addr else "127.0.0.1"
+        try:
+            client.put(self._ctrl_scope(), "addr",
+                       f"{host}:{actual_port}".encode())
+        except OSError:
+            logger.warning("could not publish controller addr to "
+                           "rendezvous", exc_info=True)
+
+    def _resolve_addr(self, env_addr):
+        """Workers: prefer the rendezvous-published address; fall back
+        to the env contract (used when no rendezvous server exists)."""
+        client = self._rendezvous_client()
+        if client is not None:
+            timeout_s = float(os.environ.get("HOROVOD_START_TIMEOUT",
+                                             120))
+            try:
+                raw = client.wait_get(self._ctrl_scope(), "addr",
+                                      timeout=timeout_s)
+                return raw.decode()
+            except (OSError, TimeoutError):
+                logger.warning("rendezvous controller-addr lookup "
+                               "failed; using env value")
+        return env_addr
 
     def _connect(self) -> socket.socket:
         # HOROVOD_START_TIMEOUT bounds the wait for the coordinator to
@@ -301,18 +418,30 @@ class NetworkController(Controller):
             try:
                 frame = _recv_frame(self._sock)
             except OSError:
-                return
+                frame = None
             if frame is None:
+                if not self._closing:
+                    from .exceptions import HorovodInternalError
+                    self._broken_err = HorovodInternalError(
+                        "connection to the coordinator was lost "
+                        "(membership changed or rank 0 exited)")
                 return
             _, payload = frame
             responses, _ = unpack_response_list(payload)
             self._recv_buf.put(responses)
 
     def compute_response_list(self, pending, entry_sizes, threshold_bytes):
+        if self._broken_err is not None:
+            raise self._broken_err
         if pending:
-            with self._send_lock:
-                _send_frame(self._sock, _MAGIC_REQ,
-                            pack_request_list(pending))
+            try:
+                with self._send_lock:
+                    _send_frame(self._sock, _MAGIC_REQ,
+                                pack_request_list(pending))
+            except OSError as e:
+                from .exceptions import HorovodInternalError
+                raise HorovodInternalError(
+                    f"could not reach the coordinator: {e}") from e
         responses: List[Response] = []
         try:
             # Block briefly: either a batch arrives or the cycle ends.
@@ -324,6 +453,13 @@ class NetworkController(Controller):
         return responses, []
 
     def shutdown(self):
+        self._closing = True
+        try:
+            with self._send_lock:
+                _send_frame(self._sock, _MAGIC_REQ,
+                            pack_request_list([], shutdown=True))
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

@@ -216,3 +216,6 @@ def metric_average(value, name: str,
     arr = np.asarray(value, dtype=np.float64)
     return float(np.asarray(allreduce(arr, op=Average, name=name,
                                       process_set=process_set)))
+
+from . import elastic  # noqa: E402  (elastic needs the names above)
+__all__.append("elastic")

@@ -1,0 +1,104 @@
+"""Elastic training for the JAX binding.
+
+The analog of the reference's per-framework elastic modules (reference:
+torch/elastic/state.py:27-150 ``TorchState``, tensorflow/elastic.py
+``run``/``TensorFlowKerasState``): a ``JaxState`` snapshots params /
+optimizer state / arbitrary python attributes in host memory, restores
+them after a failure, and broadcasts them from rank 0 after a
+membership change; ``run`` wraps the user's training function in the
+retry loop.
+
+Usage::
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax.elastic import JaxState, run
+
+    state = JaxState(params=params, opt_state=opt_state, epoch=0)
+    state.register_reset_callbacks([rescale_lr])
+
+    @run
+    def train(state):
+        while state.epoch < epochs:
+            ... train one epoch using state.params ...
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+"""
+
+import copy
+from typing import Any, Callable, Dict
+
+import jax
+
+from ..common import basics
+from ..common.elastic import ObjectState, State, run_fn
+from . import broadcast_object, broadcast_parameters
+
+
+def _reset():
+    """Re-initialize the runtime with a fresh world (reference:
+    common/elastic.py reset → shutdown + re-init; the elastic
+    rendezvous gives the new rank/size)."""
+    basics.shutdown()
+    basics.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: elastic retry loop around ``func(state, ...)``."""
+    return run_fn(func, _reset)
+
+
+class JaxState(ObjectState):
+    """Elastic state for JAX training.
+
+    Pytree attributes (``params``, ``opt_state``, anything whose leaves
+    are jax/numpy arrays) are snapshotted by value on ``save()`` and
+    broadcast leaf-wise from rank 0 on ``sync()``; plain python
+    attributes ride the pickled object path.
+    """
+
+    def __init__(self, **kwargs):
+        self._tree_attrs = {
+            k for k, v in kwargs.items() if _is_pytree_of_arrays(v)}
+        tree_kwargs = {k: kwargs.pop(k) for k in self._tree_attrs}
+        super().__init__(bcast_object=broadcast_object,
+                         get_rank=basics.rank, **kwargs)
+        self._saved_trees: Dict[str, Any] = {}
+        for k, v in tree_kwargs.items():
+            setattr(self, k, v)
+            self._saved_trees[k] = _snapshot(v)
+
+    def save(self):
+        for k in self._tree_attrs:
+            self._saved_trees[k] = _snapshot(getattr(self, k))
+        super().save()
+
+    def restore(self):
+        for k, v in self._saved_trees.items():
+            setattr(self, k, _snapshot(v))
+        super().restore()
+
+    def sync(self):
+        for k in self._tree_attrs:
+            synced = broadcast_parameters(getattr(self, k), root_rank=0,
+                                          name_prefix=f"elastic.{k}")
+            setattr(self, k, synced)
+            self._saved_trees[k] = _snapshot(synced)
+        super().sync()
+
+
+def _is_pytree_of_arrays(v) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    if not leaves:
+        return False
+    return all(hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+               for leaf in leaves)
+
+
+def _snapshot(tree):
+    """Copy a pytree of arrays to host memory (device buffers don't
+    survive a backend reset)."""
+    import numpy as np
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x), tree)

@@ -1,0 +1,77 @@
+"""Elastic state for Keras models (reference: keras/elastic.py —
+``KerasState``: model weights + optimizer state + python attributes).
+"""
+
+import numpy as np
+
+from ..common import basics
+from ..common.elastic import ObjectState, run_fn
+from .. import ops as _ops
+
+
+def _reset():
+    basics.shutdown()
+    basics.init()
+
+
+def run(func):
+    """Elastic retry-loop decorator for ``func(state, ...)``."""
+    return run_fn(func, _reset)
+
+
+def _broadcast_object(obj, root_rank=0, name="keras_elastic"):
+    from ..jax import broadcast_object
+    return broadcast_object(obj, root_rank, name=name)
+
+
+class KerasState(ObjectState):
+    """Snapshot/restore/sync for a Keras model + optimizer.
+
+    ``model`` weights and optimizer variables are captured by value on
+    ``save()`` and broadcast from rank 0 on ``sync()``; extra kwargs
+    ride the pickled-object path (epoch, batch, ...).
+    """
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        self._saved_model_weights = None
+        self._saved_opt_weights = None
+        super().__init__(bcast_object=_broadcast_object,
+                         get_rank=basics.rank, **kwargs)
+        self.save()
+
+    def _opt_vars(self):
+        if self.optimizer is None:
+            return []
+        v = getattr(self.optimizer, "variables", [])
+        return v() if callable(v) else v
+
+    def save(self):
+        self._saved_model_weights = [np.array(w) for w in
+                                     self.model.get_weights()]
+        self._saved_opt_weights = [np.array(v) for v in self._opt_vars()]
+        super().save()
+
+    def restore(self):
+        if self._saved_model_weights is not None:
+            self.model.set_weights(self._saved_model_weights)
+        opt_vars = self._opt_vars()
+        if self._saved_opt_weights and \
+                len(opt_vars) == len(self._saved_opt_weights):
+            for var, w in zip(opt_vars, self._saved_opt_weights):
+                var.assign(w)
+        super().restore()
+
+    def sync(self):
+        weights = [np.asarray(_ops.broadcast(
+            np.array(w), 0, name=f"elastic_keras/model.{i}"))
+            for i, w in enumerate(self.model.get_weights())]
+        self.model.set_weights(weights)
+        self._saved_model_weights = weights
+        opt_vars = self._opt_vars()
+        for i, var in enumerate(opt_vars):
+            var.assign(np.asarray(_ops.broadcast(
+                np.array(var), 0, name=f"elastic_keras/opt.{i}")))
+        self._saved_opt_weights = [np.array(v) for v in opt_vars]
+        super().sync()

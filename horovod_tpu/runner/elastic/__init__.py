@@ -1,0 +1,20 @@
+"""Elastic launcher subsystem: host discovery, the elastic driver, the
+worker-state registry, and the elastic rendezvous handler.
+
+The analog of the reference's ``horovod/runner/elastic/`` (reference:
+runner/elastic/{driver,discovery,registration,rendezvous,worker}.py),
+rebuilt for TPU pods: discovery can watch preemptible TPU-VM membership
+(a discovery script wrapping ``gcloud`` or the metadata server), and a
+world change re-forms the jax.distributed client + global mesh instead
+of re-running a Gloo rendezvous.
+"""
+
+from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,
+                        HostManager)
+from .driver import ElasticDriver
+from .registration import READY, SUCCESS, FAILURE, WorkerStateRegistry
+
+__all__ = [
+    "ElasticDriver", "HostDiscovery", "HostDiscoveryScript", "FixedHosts",
+    "HostManager", "WorkerStateRegistry", "READY", "SUCCESS", "FAILURE",
+]

@@ -1,0 +1,130 @@
+"""Host discovery for elastic runs.
+
+Reference: runner/elastic/discovery.py:79-165 — ``HostDiscovery``
+subclasses produce the current ``{host: slots}`` view; ``HostManager``
+tracks ordered current hosts, applies the blacklist, and detects
+changes.  The ordering contract (reference: discovery.py:113-121) is
+load-bearing: existing hosts keep their order (hence their ranks) and
+new hosts append, so surviving ranks stay stable across resets.
+"""
+
+import logging
+import subprocess
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """Returns {hostname: slots} of currently available hosts."""
+        raise NotImplementedError()
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script that prints one ``host`` or ``host:slots``
+    line per available host (reference: discovery.py:136-157)."""
+
+    def __init__(self, discovery_script: str, default_slots: int):
+        self._script = discovery_script
+        self._default_slots = default_slots
+        super().__init__()
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        stdout = subprocess.check_output(
+            self._script, shell=True, timeout=60).decode("utf-8")
+        host_slots = OrderedDict()
+        for line in stdout.strip().split("\n"):
+            line = line.strip()
+            if not line:
+                continue
+            host = line
+            if ":" in line:
+                host, slots = line.split(":", 1)
+                host_slots[host] = int(slots)
+            else:
+                host_slots[host] = self._default_slots
+        return host_slots
+
+
+class FixedHosts(HostDiscovery):
+    """A static host set (non-elastic fallback / tests,
+    reference: discovery.py:160-165)."""
+
+    def __init__(self, host_slots: Dict[str, int]):
+        super().__init__()
+        self._host_slots = host_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._host_slots)
+
+
+class TPUPodDiscovery(HostDiscovery):
+    """Discovers the healthy workers of a TPU pod slice from instance
+    metadata (TPU-native addition; preempted TPU-VM workers drop out of
+    the metadata list and re-appear on restart)."""
+
+    def __init__(self, slots: int = 1):
+        self._slots = slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        from ..tpu_metadata import discover_pod_hosts
+        hosts = discover_pod_hosts(slots=self._slots)
+        host_slots = OrderedDict()
+        if hosts:
+            for entry in hosts.split(","):
+                host, slots = entry.rsplit(":", 1)
+                host_slots[host] = int(slots)
+        return host_slots
+
+
+class HostManager:
+    """Tracks current hosts in stable order + the blacklist
+    (reference: discovery.py:79-134)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._current_hosts = OrderedDict()  # host -> slots, ordered
+        self._discovery = discovery
+        self._blacklist: Set[str] = set()
+        self._lock = threading.Lock()
+
+    def update_available_hosts(self) -> bool:
+        """Polls discovery; returns True when the available (ordered,
+        non-blacklisted) host set changed."""
+        available = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            prev = OrderedDict(
+                (h, s) for h, s in self._current_hosts.items())
+            # Keep surviving hosts in their existing order, then append
+            # newly discovered hosts in discovery order.
+            updated = OrderedDict()
+            for host, slots in self._current_hosts.items():
+                if host in available and host not in self._blacklist:
+                    updated[host] = available[host]
+            for host, slots in available.items():
+                if host not in updated and host not in self._blacklist:
+                    updated[host] = slots
+            self._current_hosts = updated
+            return prev != updated
+
+    @property
+    def current_hosts(self) -> "OrderedDict":
+        with self._lock:
+            return OrderedDict(self._current_hosts)
+
+    def blacklist(self, host: str):
+        with self._lock:
+            if host not in self._blacklist:
+                logger.warning("blacklisting host %s", host)
+            self._blacklist.add(host)
+            self._current_hosts.pop(host, None)
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    def available_slots(self) -> int:
+        with self._lock:
+            return sum(self._current_hosts.values())

@@ -1,0 +1,302 @@
+"""The elastic driver: monitors host membership, replans rank
+assignments, and manages worker processes across resets.
+
+Reference: runner/elastic/driver.py — discovery poll thread
+(``_discover_hosts`` :177-196), rank-stable assignment recomputation
+(``_update_host_assignments`` :228-260, ≥1 surviving host required
+:242-243), worker spawn/respawn, and result collection.
+
+TPU-native deltas:
+  * every epoch publishes a fresh ``jax.distributed`` coordinator and
+    negotiation-controller address in the rendezvous KV store — a world
+    change re-forms the JAX client + global mesh in-place on surviving
+    workers (no process restart);
+  * workers learn of membership changes by polling the KV discovery
+    generation at ``state.commit()`` instead of a per-worker push RPC.
+"""
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..hosts import (HostInfo, INVALID_SLOT_INFO, SlotInfo,
+                     get_host_assignments)
+from ..http_server import find_ports
+from .discovery import HostDiscovery, HostManager
+from .registration import WorkerStateRegistry
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
+
+# KV scopes/keys the driver publishes (worker side reads these).
+ELASTIC_SCOPE = "elastic"
+KEY_GENERATION = "generation"     # bumped on every discovery change
+
+
+
+class _LiveWorker:
+    def __init__(self, slot: SlotInfo, epoch: int,
+                 thread: threading.Thread):
+        self.slot = slot
+        self.epoch = epoch
+        self.thread = thread
+
+
+class ElasticDriver:
+    def __init__(self, rendezvous, discovery: HostDiscovery, min_np: int,
+                 max_np: Optional[int] = None, timeout: float = 600,
+                 reset_limit: Optional[int] = None, verbose: int = 0):
+        self._rendezvous = rendezvous
+        self._host_manager = HostManager(discovery)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._timeout = timeout
+        self._verbose = verbose
+        self._registry = WorkerStateRegistry(self, self._host_manager,
+                                             reset_limit=reset_limit)
+        self._create_worker_fn: Optional[Callable] = None
+
+        self._lock = threading.RLock()
+        self._assign_cond = threading.Condition(self._lock)
+        self._epoch = 0
+        self._world_size = 0
+        self._host_assignments: Dict[str, List[SlotInfo]] = {}
+        self._rank0_addr: Optional[str] = None
+        self._world_info: Dict = {}
+        self._live: Dict[Tuple[str, int], _LiveWorker] = {}
+        self._results: Dict[str, int] = {}     # "host:slot" -> exit code
+        self._generation = 0
+
+        self._shutdown = threading.Event()
+        self._error_message: Optional[str] = None
+        self._discovery_thread = threading.Thread(
+            target=self._discover_hosts, name="hvd-elastic-discovery",
+            daemon=True)
+
+    # ------------------------------------------------------------------
+    # public API (used by the launcher and the rendezvous handler)
+    # ------------------------------------------------------------------
+    @property
+    def registry(self) -> WorkerStateRegistry:
+        return self._registry
+
+    @property
+    def host_manager(self) -> HostManager:
+        return self._host_manager
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def start(self, np: int, create_worker_fn: Callable[[SlotInfo], int]):
+        """Wait for min_np slots, plan the first epoch, spawn workers."""
+        self._create_worker_fn = create_worker_fn
+        self.wait_for_available_slots(max(np or 0, self._min_np))
+        with self._lock:
+            self._plan_epoch()
+            self._registry.reset(self._world_size)
+            self._spawn_missing()
+        self._discovery_thread.start()
+
+    def record_ready(self, host: str, slot: int):
+        self._registry.record_ready(host, slot)
+
+    def get_slot_info(self, host: str, local_rank: int, last_epoch: int,
+                      timeout: float = 10.0) -> Tuple[SlotInfo, Dict, int]:
+        """Blocks (bounded) until an epoch newer than ``last_epoch`` is
+        planned; returns (slot_info, world_info, epoch).  slot_info is
+        INVALID_SLOT_INFO when the slot was retired from the plan."""
+        deadline = time.monotonic() + timeout
+        with self._assign_cond:
+            while self._epoch <= last_epoch and not self._shutdown.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None, {}, self._epoch   # still pending
+                self._assign_cond.wait(remaining)
+            if self._shutdown.is_set():
+                return INVALID_SLOT_INFO, dict(self._world_info), self._epoch
+            for s in self._host_assignments.get(host, []):
+                if s.local_rank == local_rank:
+                    return s, dict(self._world_info), self._epoch
+            return INVALID_SLOT_INFO, dict(self._world_info), self._epoch
+
+    def resume(self):
+        """Replan the world after a barrier evaluation and (re)spawn
+        worker processes for slots without a live worker."""
+        with self._lock:
+            if self._shutdown.is_set():
+                return
+            if not self._wait_for_min_slots_locked():
+                return
+            self._plan_epoch()
+            self._registry.reset(self._world_size)
+            self._spawn_missing()
+
+    def stop(self, error_message: Optional[str] = None):
+        with self._assign_cond:
+            self._error_message = error_message or self._error_message
+            self._shutdown.set()
+            self._assign_cond.notify_all()
+
+    def finished(self) -> bool:
+        return self._shutdown.is_set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the run finishes; returns True on clean finish."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._shutdown.wait(timeout)
+        # Let worker monitor threads drain.
+        for lw in list(self._live.values()):
+            t = None if deadline is None else max(0.0,
+                                                  deadline - time.monotonic())
+            lw.thread.join(t)
+        return self._error_message is None
+
+    @property
+    def error_message(self) -> Optional[str]:
+        return self._error_message
+
+    def get_results(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._results)
+
+    def wait_for_available_slots(self, min_np: int):
+        """Poll discovery until at least min_np slots exist (reference:
+        driver.py wait_for_available_slots)."""
+        deadline = time.monotonic() + self._timeout
+        while time.monotonic() < deadline:
+            self._host_manager.update_available_hosts()
+            if self._host_manager.available_slots() >= min_np:
+                return
+            if self._shutdown.is_set():
+                raise RuntimeError("elastic driver shut down while waiting "
+                                   "for hosts")
+            time.sleep(DISCOVER_HOSTS_FREQUENCY_SECS)
+        raise TimeoutError(
+            f"Timed out waiting for {min_np} slots; only "
+            f"{self._host_manager.available_slots()} available.")
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _wait_for_min_slots_locked(self) -> bool:
+        if self._host_manager.available_slots() >= self._min_np:
+            return True
+        # Release the lock while waiting so discovery can make progress.
+        self._lock.release()
+        try:
+            self.wait_for_available_slots(self._min_np)
+            return True
+        except TimeoutError as e:
+            self.stop(error_message=str(e))
+            return False
+        finally:
+            self._lock.acquire()
+
+    def _plan_epoch(self):
+        """Compute rank-stable assignments for a new epoch and publish
+        the epoch's world info (coordinator/controller endpoints)."""
+        current = self._host_manager.current_hosts
+        if not current:
+            raise RuntimeError("no hosts available to plan an epoch")
+        host_infos = [HostInfo(h, s) for h, s in current.items()]
+        slots = get_host_assignments(host_infos, self._min_np,
+                                     self._max_np)
+        self._epoch += 1
+        self._world_size = slots[0].size if slots else 0
+        assignments: Dict[str, List[SlotInfo]] = OrderedDict()
+        for s in slots:
+            assignments.setdefault(s.hostname, []).append(s)
+        self._host_assignments = assignments
+        self._rank0_addr = slots[0].hostname
+        coord_port, ctrl_port = find_ports(2)
+        rank0 = slots[0].hostname
+        # Local host aliases must resolve from every worker; keep
+        # loopback for single-host runs, hostname otherwise.
+        from ..tpu_run import is_local
+        addr = "127.0.0.1" if is_local(rank0) else rank0
+        self._world_info = {
+            "epoch": self._epoch,
+            "size": self._world_size,
+            "coordinator": f"{addr}:{coord_port}",
+            "controller_addr": f"{addr}:{ctrl_port}",
+            # Discovery generation this plan reflects: workers seed
+            # their change-poll with it, so a change landing between
+            # plan and worker init is still noticed.
+            "generation": self._generation,
+        }
+        if self._rendezvous is not None:
+            self._rendezvous.init(self._host_assignments)
+        logger.info("elastic: epoch %d planned, size=%d hosts=%s",
+                    self._epoch, self._world_size, list(current.keys()))
+        self._assign_cond.notify_all()
+
+    def _spawn_missing(self):
+        for host, slots in self._host_assignments.items():
+            for slot in slots:
+                key = (host, slot.local_rank)
+                lw = self._live.get(key)
+                if lw is not None and lw.thread.is_alive():
+                    continue
+                self._spawn(slot)
+
+    def _spawn(self, slot: SlotInfo):
+        key = (slot.hostname, slot.local_rank)
+        epoch = self._epoch
+
+        def monitor():
+            try:
+                code = self._create_worker_fn(slot)
+            except Exception:
+                logger.exception("worker launch failed for %s", key)
+                code = 1
+            self._on_worker_exit(slot.hostname, slot.local_rank, code)
+
+        t = threading.Thread(target=monitor,
+                             name=f"hvd-elastic-{slot.hostname}-"
+                                  f"{slot.local_rank}",
+                             daemon=True)
+        self._live[key] = _LiveWorker(slot, epoch, t)
+        t.start()
+
+    def _on_worker_exit(self, host: str, local_rank: int, code: int):
+        with self._lock:
+            in_plan = any(s.local_rank == local_rank
+                          for s in self._host_assignments.get(host, []))
+            self._results[f"{host}:{local_rank}"] = code
+        if self._shutdown.is_set():
+            return
+        if not in_plan:
+            logger.debug("retired worker %s:%d exited with %d", host,
+                         local_rank, code)
+            return
+        if code == 0:
+            self._registry.record_success(host, local_rank)
+        else:
+            logger.warning("worker %s:%d failed with exit code %d", host,
+                           local_rank, code)
+            self._registry.record_failure(host, local_rank)
+
+    def _discover_hosts(self):
+        while not self._shutdown.is_set():
+            try:
+                changed = self._host_manager.update_available_hosts()
+            except Exception:
+                logger.exception("host discovery failed; retrying")
+                changed = False
+            if changed:
+                with self._lock:
+                    self._generation += 1
+                    gen = self._generation
+                logger.info("elastic: host membership changed "
+                            "(generation %d)", gen)
+                if self._rendezvous is not None and \
+                        self._rendezvous.kvstore is not None:
+                    self._rendezvous.kvstore.put(
+                        ELASTIC_SCOPE, KEY_GENERATION,
+                        str(gen).encode())
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)

@@ -1,0 +1,120 @@
+"""Worker-side elastic plumbing: rendezvous for a fresh rank identity
+and the host-update poll source.
+
+Reference: the worker half of gloo_context.cc:154-200 (elastic rank
+re-query at re-init) + runner/elastic/worker.py (host-update
+notification).  Here both ride the driver's rendezvous KV store: rank
+identity via the long-polled ``rank_and_size`` scope, membership-change
+notification by polling the ``elastic/generation`` key at
+``state.commit()`` time.
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from ...common import env as env_mod
+from ...common.elastic import HostUpdateSource
+from ..http_server import RendezvousClient
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+
+class HostsRemovedError(SystemExit):
+    """This worker's slot was retired from the plan; exit cleanly."""
+
+    def __init__(self):
+        super().__init__(0)
+
+
+def _client() -> RendezvousClient:
+    addr = os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR]
+    port = int(os.environ[env_mod.HOROVOD_RENDEZVOUS_PORT])
+    return RendezvousClient(addr, port)
+
+
+# The epoch this process last rendezvoused at (0 = never).
+_last_epoch = 0
+
+
+def current_epoch() -> int:
+    return _last_epoch
+
+
+def elastic_rendezvous(timeout: Optional[float] = None) -> Dict:
+    """Ask the driver for this worker's rank assignment in the next
+    epoch.  Blocks until the driver has planned it; updates the process
+    env contract (rank vars + coordinator/controller endpoints) and
+    returns the assignment dict.
+
+    Raises HostsRemovedError when the slot was retired.
+    """
+    global _last_epoch
+    client = _client()
+    hostname = os.environ.get(env_mod.HOROVOD_HOSTNAME, "localhost")
+    local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))
+    timeout = timeout or float(os.environ.get("HOROVOD_START_TIMEOUT",
+                                              600))
+    deadline = time.monotonic() + timeout
+    key = f"{hostname}:{local_rank}?last_epoch={_last_epoch}"
+    while time.monotonic() < deadline:
+        try:
+            raw = client.get(env_mod.GET_RANK_AND_SIZE, key)
+        except OSError:
+            # Transient HTTP hiccup (server busy mid-replan); retry.
+            time.sleep(0.25)
+            continue
+        if raw is None:
+            time.sleep(0.25)
+            continue
+        info = json.loads(raw.decode())
+        if info.get("pending"):
+            continue
+        if info.get("invalid"):
+            logger.info("elastic: slot retired; exiting cleanly")
+            raise HostsRemovedError()
+        _last_epoch = int(info["epoch"])
+        os.environ[env_mod.HOROVOD_RANK] = str(info["rank"])
+        os.environ[env_mod.HOROVOD_SIZE] = str(info["size"])
+        os.environ[env_mod.HOROVOD_LOCAL_RANK] = str(info["local_rank"])
+        os.environ[env_mod.HOROVOD_LOCAL_SIZE] = str(info["local_size"])
+        os.environ[env_mod.HOROVOD_CROSS_RANK] = str(info["cross_rank"])
+        os.environ[env_mod.HOROVOD_CROSS_SIZE] = str(info["cross_size"])
+        if "coordinator" in info:
+            os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = \
+                info["coordinator"]
+        if "controller_addr" in info:
+            os.environ["HOROVOD_CONTROLLER_ADDR"] = \
+                info["controller_addr"]
+        logger.info("elastic: rendezvous epoch %d rank %d/%d",
+                    _last_epoch, info["rank"], info["size"])
+        return info
+    raise TimeoutError("elastic rendezvous timed out")
+
+
+class RendezvousHostUpdateSource(HostUpdateSource):
+    """Polls the driver's discovery generation key; a change since the
+    last check means membership changed."""
+
+    def __init__(self, seed_generation: int = 0):
+        # Seeded with the generation the current epoch's plan reflects:
+        # any bump after the plan (even one landing before this worker
+        # finished init) must still trigger an interrupt.
+        self._last_seen = seed_generation
+        self._client = _client()
+
+    def has_update(self) -> bool:
+        from .driver import ELASTIC_SCOPE, KEY_GENERATION
+        try:
+            raw = self._client.get(ELASTIC_SCOPE, KEY_GENERATION)
+        except OSError:
+            return False
+        if raw is None:
+            return False
+        gen = int(raw.decode())
+        if gen > self._last_seen:
+            self._last_seen = gen
+            return True
+        return False

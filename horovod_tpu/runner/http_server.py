@@ -193,11 +193,23 @@ class RendezvousClient:
 
 
 def find_port() -> int:
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return find_ports(1)[0]
+
+
+def find_ports(n: int):
+    """n distinct free ports; all sockets held open until every port is
+    chosen so the same port can't be handed out twice."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
 
 
 def local_addresses():

@@ -297,6 +297,8 @@ def _run_elastic(args):
         max_np=args.max_np,
         reset_limit=args.reset_limit,
         elastic_timeout=args.elastic_timeout,
+        ssh_port=args.ssh_port,
+        ssh_identity_file=args.ssh_identity_file,
         output_filename=args.output_filename,
         verbose=1 if args.verbose else 0,
         extra_worker_env=worker_env)

@@ -30,7 +30,7 @@ from typing import Callable, Dict, List, Optional
 from . import safe_shell_exec
 from .hosts import SlotInfo, get_host_assignments, parse_hosts, \
     slot_env_vars
-from .http_server import RendezvousServer, find_port, local_addresses
+from .http_server import RendezvousServer, find_ports, local_addresses
 
 logger = logging.getLogger("horovod_tpu.run")
 
@@ -141,8 +141,7 @@ def launch_static(command: List[str],
     else:
         rank0_addr = rank0_host
 
-    coordinator_port = find_port()
-    controller_port = find_port()
+    coordinator_port, controller_port = find_ports(2)
     common_env = {
         "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
         "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),

@@ -1,0 +1,55 @@
+"""Elastic state for TensorFlow (reference: tensorflow/elastic.py:31,91
+— ``run`` wrapper and ``TensorFlowKerasState``).
+"""
+
+import numpy as np
+
+from ..common import basics
+from ..common.elastic import ObjectState, run_fn
+from .. import ops as _ops
+from ..keras.elastic import KerasState as TensorFlowKerasState  # noqa: F401
+
+
+def _reset():
+    basics.shutdown()
+    basics.init()
+
+
+def run(func):
+    """Elastic retry-loop decorator (reference: tensorflow/elastic.py
+    run)."""
+    return run_fn(func, _reset)
+
+
+class TensorFlowState(ObjectState):
+    """Snapshot/restore/sync for a collection of tf.Variables
+    (reference: tensorflow/elastic.py TensorFlowState)."""
+
+    def __init__(self, variables=None, **kwargs):
+        self.variables = list(variables or [])
+        self._saved = None
+
+        def bcast(obj):
+            from ..jax import broadcast_object
+            return broadcast_object(obj, 0, name="tf_elastic")
+
+        super().__init__(bcast_object=bcast, get_rank=basics.rank,
+                         **kwargs)
+        self.save()
+
+    def save(self):
+        self._saved = [np.array(v) for v in self.variables]
+        super().save()
+
+    def restore(self):
+        if self._saved is not None:
+            for var, w in zip(self.variables, self._saved):
+                var.assign(w)
+        super().restore()
+
+    def sync(self):
+        for i, var in enumerate(self.variables):
+            var.assign(np.asarray(_ops.broadcast(
+                np.array(var), 0, name=f"tf_elastic/var.{i}")))
+        self._saved = [np.array(v) for v in self.variables]
+        super().sync()

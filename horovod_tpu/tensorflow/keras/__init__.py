@@ -1,0 +1,10 @@
+"""``horovod_tpu.tensorflow.keras`` — alias of the Keras binding bound
+to ``tf.keras`` (reference: horovod/tensorflow/keras/__init__.py).
+With TF ≥ 2.16 ``tf.keras`` *is* Keras 3, so the shared implementation
+is identical.
+"""
+
+from ...keras import *            # noqa: F401,F403
+from ...keras import (DistributedOptimizer, broadcast_variables,
+                      broadcast_model, allreduce, allgather, broadcast,
+                      load_model, callbacks, elastic)  # noqa: F401

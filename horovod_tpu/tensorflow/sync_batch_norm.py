@@ -1,0 +1,67 @@
+"""Synchronized batch normalization for Keras/TF.
+
+Reference: tensorflow/sync_batch_norm.py:26-60 — batch moments are
+computed across ALL ranks by allreducing the stacked
+[mean, mean-of-squares] so every worker normalizes with global batch
+statistics (essential when per-worker batches are small).
+
+Implemented as a Keras-3 layer: local moments → one stacked-moment
+allreduce (Average) → global mean/var → normalize.  Inference uses the
+moving statistics like plain BatchNormalization.
+"""
+
+import numpy as np
+import keras
+from keras import ops as K
+
+from ..common import basics
+from ..common.basics import Average, global_process_set
+from .. import ops as _ops
+
+
+class SyncBatchNormalization(keras.layers.BatchNormalization):
+    """Drop-in BatchNormalization with cross-rank batch statistics."""
+
+    def __init__(self, process_set=global_process_set, **kwargs):
+        super().__init__(**kwargs)
+        self._process_set = process_set
+
+    def call(self, inputs, training=None, mask=None):
+        if not training or self._process_set.size() == 1:
+            return super().call(inputs, training=training, mask=mask)
+
+        x = K.convert_to_tensor(inputs)
+        ndim = len(x.shape)
+        axis = self.axis if self.axis >= 0 else ndim + self.axis
+        reduce_axes = [i for i in range(ndim) if i != axis]
+
+        local_mean = K.mean(x, axis=reduce_axes)
+        local_sq_mean = K.mean(K.square(x), axis=reduce_axes)
+        # One fused allreduce of the stacked moments (reference
+        # stacks mean and mean-of-squares into a single tensor).
+        stacked = K.stack([local_mean, local_sq_mean])
+        reduced = _ops.allreduce(
+            np.asarray(stacked), op=Average,
+            name=f"sync_bn/{self.name}",
+            process_set=self._process_set)
+        reduced = K.convert_to_tensor(np.asarray(reduced))
+        mean = reduced[0]
+        var = reduced[1] - K.square(mean)
+
+        # Update moving statistics exactly like the base layer.
+        momentum = K.cast(self.momentum, mean.dtype)
+        self.moving_mean.assign(self.moving_mean * momentum +
+                                mean * (1.0 - momentum))
+        self.moving_variance.assign(self.moving_variance * momentum +
+                                    var * (1.0 - momentum))
+
+        shape = [1] * ndim
+        shape[axis] = x.shape[axis]
+        mean = K.reshape(mean, shape)
+        var = K.reshape(var, shape)
+        out = (x - mean) / K.sqrt(var + self.epsilon)
+        if self.scale:
+            out = out * K.reshape(self.gamma, shape)
+        if self.center:
+            out = out + K.reshape(self.beta, shape)
+        return out

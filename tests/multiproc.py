@@ -30,11 +30,25 @@ SIZE = hvd.size()
 
 
 def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return free_ports(1)[0]
+
+
+def free_ports(n: int) -> List[int]:
+    """Allocate n distinct free ports, holding all sockets open until
+    every port is chosen (sequential bind/close can hand out the same
+    port twice — the jax coordinator and the controller server would
+    then race for it)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
 
 
 def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
@@ -43,8 +57,7 @@ def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
     """Run ``body`` (dedented python source, sees RANK/SIZE/np/hvd/jax)
     in ``nproc`` worker processes.  Returns [(returncode, output)].
     """
-    coord_port = free_port()
-    ctrl_port = free_port()
+    coord_port, ctrl_port = free_ports(2)
     code = _PRELUDE + textwrap.dedent(body)
     procs = []
     for rank in range(nproc):

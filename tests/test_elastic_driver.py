@@ -1,0 +1,175 @@
+"""Unit tests for the elastic driver/registry/discovery — fake workers,
+no real processes (mirrors reference test/single/test_elastic_driver.py:
+ElasticDriver with fake discovery objects and simulated worker exits).
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import FixedHosts, HostManager
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+from horovod_tpu.runner.hosts import INVALID_SLOT_INFO
+
+
+class MutableDiscovery(FixedHosts):
+    def set(self, host_slots):
+        self._host_slots = dict(host_slots)
+
+
+class FakeWorkers:
+    """create_worker_fn whose workers block until released with a code."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.events = {}    # (host, local_rank) -> (event, [code])
+        self.spawned = []
+
+    def create(self, slot):
+        key = (slot.hostname, slot.local_rank)
+        ev = threading.Event()
+        box = [0]
+        with self.lock:
+            self.events[key] = (ev, box)
+            self.spawned.append(key)
+        ev.wait(timeout=30)
+        return box[0]
+
+    def release(self, host, local_rank, code=0):
+        deadline = time.monotonic() + 5
+        key = (host, local_rank)
+        while time.monotonic() < deadline:
+            with self.lock:
+                if key in self.events:
+                    ev, box = self.events.pop(key)
+                    box[0] = code
+                    ev.set()
+                    return
+            time.sleep(0.01)
+        raise AssertionError(f"worker {key} never spawned")
+
+    def release_all(self, code=0):
+        with self.lock:
+            items = list(self.events.items())
+            self.events.clear()
+        for _, (ev, box) in items:
+            box[0] = code
+            ev.set()
+
+
+def make_driver(discovery, min_np, max_np=None, **kw):
+    return ElasticDriver(rendezvous=None, discovery=discovery,
+                         min_np=min_np, max_np=max_np, timeout=5, **kw)
+
+
+def test_host_manager_ordering_and_blacklist():
+    disc = MutableDiscovery({"a": 2, "b": 2})
+    hm = HostManager(disc)
+    assert hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["a", "b"]
+    # New host appends; existing order stable.
+    disc.set({"c": 1, "a": 2, "b": 2})
+    assert hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["a", "b", "c"]
+    # Blacklisting removes immediately and the host never returns.
+    hm.blacklist("b")
+    assert list(hm.current_hosts) == ["a", "c"]
+    assert not hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["a", "c"]
+    assert hm.available_slots() == 3
+
+
+def test_driver_start_assigns_ranks():
+    workers = FakeWorkers()
+    driver = make_driver(FixedHosts({"a": 2, "b": 2}), min_np=4)
+    driver.start(4, workers.create)
+    time.sleep(0.2)
+    assert sorted(workers.spawned) == [("a", 0), ("a", 1),
+                                       ("b", 0), ("b", 1)]
+    slot, world, epoch = driver.get_slot_info("b", 1, last_epoch=0)
+    assert epoch == 1
+    assert slot.rank == 3 and slot.size == 4
+    assert slot.cross_rank == 1 and slot.cross_size == 2
+    assert world["size"] == 4
+    assert "coordinator" in world and "controller_addr" in world
+    workers.release_all(0)
+    assert driver.join(timeout=10)
+    assert driver.error_message is None
+    driver.stop()
+
+
+def test_driver_failure_blacklists_and_replans():
+    workers = FakeWorkers()
+    driver = make_driver(FixedHosts({"a": 2, "b": 2}), min_np=2)
+    driver.start(4, workers.create)
+    time.sleep(0.2)
+    # b:0 crashes; survivors re-rendezvous (arrive READY).
+    workers.release("b", 0, code=1)
+    time.sleep(0.2)
+    driver.record_ready("a", 0)
+    driver.record_ready("a", 1)
+    driver.record_ready("b", 1)   # barrier completes -> resume
+    slot, world, epoch = driver.get_slot_info("a", 1, last_epoch=1)
+    assert epoch == 2
+    assert slot.size == 2 and slot.rank == 1
+    assert driver.host_manager.is_blacklisted("b")
+    # The surviving slot on the blacklisted host is retired.
+    slot_b, _, _ = driver.get_slot_info("b", 1, last_epoch=1)
+    assert slot_b == INVALID_SLOT_INFO
+    assert driver.registry.reset_count == 1
+    workers.release_all(0)
+    driver.stop()
+
+
+def test_driver_reset_limit_aborts():
+    workers = FakeWorkers()
+    driver = make_driver(FixedHosts({"a": 2, "b": 2}), min_np=2,
+                         reset_limit=0)
+    driver.start(4, workers.create)
+    time.sleep(0.2)
+    workers.release("b", 0, code=1)
+    time.sleep(0.2)
+    driver.record_ready("a", 0)
+    driver.record_ready("a", 1)
+    driver.record_ready("b", 1)
+    assert driver.finished()
+    assert "reset limit" in driver.error_message
+    workers.release_all(0)
+
+
+def test_driver_host_added_grows_world():
+    workers = FakeWorkers()
+    disc = MutableDiscovery({"a": 2})
+    driver = make_driver(disc, min_np=2)
+    driver.start(2, workers.create)
+    time.sleep(0.2)
+    assert driver.epoch == 1
+    disc.set({"a": 2, "b": 2})
+    # Discovery thread polls at 1s cadence.
+    deadline = time.monotonic() + 5
+    while driver.host_manager.available_slots() < 4 and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    # Workers notice (generation bump) and re-rendezvous.
+    driver.record_ready("a", 0)
+    driver.record_ready("a", 1)
+    slot, world, epoch = driver.get_slot_info("a", 0, last_epoch=1)
+    assert epoch == 2
+    assert slot.size == 4
+    time.sleep(0.2)
+    assert ("b", 0) in workers.spawned and ("b", 1) in workers.spawned
+    workers.release_all(0)
+    driver.stop()
+
+
+def test_all_success_stops_cleanly():
+    workers = FakeWorkers()
+    driver = make_driver(FixedHosts({"a": 2}), min_np=2)
+    driver.start(2, workers.create)
+    time.sleep(0.2)
+    workers.release_all(0)
+    assert driver.join(timeout=10)
+    assert driver.finished()
+    assert driver.error_message is None
+    assert set(driver.get_results().values()) == {0}

@@ -1,0 +1,127 @@
+"""End-to-end elastic test: real worker processes, scripted discovery
+churn (the reference's integration technique — a discovery script whose
+output changes mid-run, test/integration/elastic_common.py:34-65).
+
+World grows localhost:2 → localhost:3 while training runs; surviving
+workers re-form the jax.distributed world in-process; the new worker
+syncs committed state; training continues with size 3.
+"""
+
+import os
+import re
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SCRIPT = """
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+import horovod_tpu.jax as hj
+from horovod_tpu.jax.elastic import JaxState, run
+
+hvd.init()
+state = JaxState(epoch=0)
+STOP = os.environ["TEST_STOP_FILE"]
+
+@run
+def train(state):
+    while not os.path.exists(STOP):
+        val = np.asarray(hj.allreduce(
+            np.ones(4, np.float32), op=hvd.Sum,
+            name=f"t{state.epoch}"))
+        assert val[0] == hvd.size(), (val, hvd.size())
+        print(f"EPOCH {state.epoch} rank={hvd.rank()} "
+              f"size={hvd.size()}", flush=True)
+        state.epoch += 1
+        state.commit()
+        time.sleep(0.05)
+    return state.epoch
+
+train(state)
+print(f"DONE rank={hvd.rank()} epoch={state.epoch}", flush=True)
+"""
+
+
+def _scan_logs(outdir):
+    text = ""
+    for root, _, files in os.walk(outdir):
+        for f in files:
+            with open(os.path.join(root, f),
+                      errors="replace") as fh:
+                text += fh.read()
+    return text
+
+
+def test_elastic_world_grows(tmp_path):
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner.elastic_run import launch_elastic
+
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:2\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+    stop_file = tmp_path / "stop"
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER_SCRIPT)
+    outdir = tmp_path / "out"
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    result = {}
+
+    def run_launcher():
+        try:
+            result["codes"] = launch_elastic(
+                [sys.executable, str(worker_py)],
+                discovery=HostDiscoveryScript(str(script), 1),
+                np=2, min_np=2, max_np=3,
+                elastic_timeout=60,
+                output_filename=str(outdir),
+                env=env,
+                extra_worker_env={
+                    "HOROVOD_TPU_FORCE_CPU": "1",
+                    "TEST_STOP_FILE": str(stop_file),
+                    "HOROVOD_START_TIMEOUT": "60",
+                })
+        except Exception as e:   # surfaced in the main thread
+            result["error"] = e
+
+    t = threading.Thread(target=run_launcher, daemon=True)
+    t.start()
+
+    def wait_for(pattern, timeout=120):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if re.search(pattern, _scan_logs(outdir)):
+                return
+            if not t.is_alive():
+                raise AssertionError(
+                    f"launcher exited early: {result}\n"
+                    f"logs:\n{_scan_logs(outdir)[-3000:]}")
+            time.sleep(0.5)
+        raise AssertionError(
+            f"pattern {pattern!r} never appeared; logs:\n"
+            f"{_scan_logs(outdir)[-3000:]}")
+
+    # Phase 1: two workers train at size 2.
+    wait_for(r"EPOCH \d+ rank=\d size=2")
+    # Phase 2: a third slot appears; world re-forms at size 3.
+    hosts_file.write_text("localhost:3\n")
+    wait_for(r"EPOCH \d+ rank=2 size=3")
+    # Phase 3: stop; everyone exits cleanly.
+    stop_file.write_text("")
+    t.join(timeout=120)
+    assert not t.is_alive(), "launcher did not finish"
+    assert "error" not in result, result.get("error")
+    assert set(result["codes"].values()) == {0}
+    logs = _scan_logs(outdir)
+    assert len(re.findall(r"DONE rank=\d", logs)) == 3

@@ -1,0 +1,124 @@
+"""Unit tests for the elastic State machine and retry loop (mirrors
+reference test/single/test_torch_elastic.py style: state save/restore/
+sync with the world mocked out)."""
+
+import pytest
+
+from horovod_tpu.common.elastic import (ObjectState, QueueHostUpdateSource,
+                                        State, run_fn,
+                                        set_host_update_source)
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+
+
+class SimpleState(State):
+    def __init__(self, value=0):
+        super().__init__()
+        self.value = value
+        self.committed = None
+        self.synced = 0
+        self.resets = 0
+
+    def save(self):
+        self.committed = self.value
+
+    def restore(self):
+        self.value = self.committed
+
+    def sync(self):
+        self.synced += 1
+
+    def reset(self):
+        self.resets += 1
+
+
+@pytest.fixture(autouse=True)
+def clear_source():
+    set_host_update_source(None)
+    yield
+    set_host_update_source(None)
+
+
+def test_commit_and_restore():
+    s = SimpleState(value=1)
+    s.commit()
+    s.value = 99
+    s.restore()
+    assert s.value == 1
+
+
+def test_commit_raises_on_host_update():
+    s = SimpleState()
+    src = QueueHostUpdateSource()
+    set_host_update_source(src)
+    s.commit()  # no update pending
+    src.put()
+    with pytest.raises(HostsUpdatedInterrupt):
+        s.commit()
+    # The queue drained; next commit is quiet.
+    s.commit()
+
+
+def test_run_fn_restores_after_internal_error():
+    s = SimpleState(value=10)
+    resets = []
+
+    calls = []
+
+    def train(state):
+        calls.append(1)
+        if len(calls) == 1:
+            state.commit()
+            state.value = 55        # uncommitted progress
+            raise HorovodInternalError("collective failed")
+        return state.value
+
+    wrapped = run_fn(train, lambda: resets.append(1))
+    assert wrapped(s) == 10         # restored committed value
+    assert len(resets) == 1
+    assert s.resets == 1
+    assert s.synced == 2            # initial sync + post-reset sync
+
+
+def test_run_fn_keeps_state_on_hosts_updated():
+    s = SimpleState(value=3)
+    calls = []
+
+    def train(state):
+        calls.append(1)
+        if len(calls) == 1:
+            state.value = 7
+            state.commit()
+            raise HostsUpdatedInterrupt()
+        return state.value
+
+    wrapped = run_fn(train, lambda: None)
+    assert wrapped(s) == 7          # committed value survives
+
+
+def test_object_state_save_restore_sync():
+    synced = {}
+
+    def bcast(obj):
+        synced["obj"] = obj
+        return {"epoch": 42, "batch": 0}
+
+    s = ObjectState(bcast_object=bcast, get_rank=lambda: 0,
+                    epoch=5, batch=2)
+    assert s.epoch == 5 and s.batch == 2
+    s.epoch = 6
+    s.save()
+    s.epoch = 99
+    s.restore()
+    assert s.epoch == 6
+    s.sync()
+    assert s.epoch == 42 and s.batch == 0
+    assert synced["obj"]["epoch"] == 6
+
+
+def test_reset_callbacks_fire_on_reset():
+    s = SimpleState()
+    fired = []
+    s.register_reset_callbacks([lambda: fired.append(1)])
+    s.on_reset()
+    assert fired == [1]

@@ -1,0 +1,156 @@
+"""TF2/Keras binding tests (single-process; the multi-process path is
+covered by test_multiproc_ops.py's runtime, which these bindings stage
+into).  Mirrors the reference's per-op coverage style
+(test/parallel/test_tensorflow.py) at world size 1."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module")
+def hvd_tf():
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    yield hvd
+
+
+def test_allreduce_dense(hvd_tf):
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = hvd_tf.allreduce(x, op=hvd_tf.Sum)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+    out = hvd_tf.allreduce(x, op=hvd_tf.Average)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_allreduce_prescale(hvd_tf):
+    x = tf.constant([2.0, 4.0])
+    out = hvd_tf.allreduce(x, op=hvd_tf.Sum, prescale_factor=0.5)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+
+def test_allreduce_indexed_slices(hvd_tf):
+    slices = tf.IndexedSlices(
+        values=tf.constant([[1.0, 2.0]]), indices=tf.constant([1]),
+        dense_shape=tf.constant([4, 2]))
+    out = hvd_tf.allreduce(slices, op=hvd_tf.Average)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), [[1.0, 2.0]])
+
+
+def test_allgather_broadcast(hvd_tf):
+    x = tf.constant([[1, 2], [3, 4]], dtype=tf.int32)
+    out = hvd_tf.allgather(x)
+    np.testing.assert_array_equal(out.numpy(), x.numpy())
+    out = hvd_tf.broadcast(x, root_rank=0)
+    np.testing.assert_array_equal(out.numpy(), x.numpy())
+
+
+def test_graph_mode_allreduce(hvd_tf):
+    @tf.function
+    def fn(t):
+        return hvd_tf.allreduce(t, op=hvd_tf.Sum)
+
+    out = fn(tf.constant([5.0, 6.0]))
+    np.testing.assert_allclose(out.numpy(), [5.0, 6.0])
+
+
+def test_scalar_ops_read_at_execution(hvd_tf):
+    @tf.function
+    def fn():
+        return hvd_tf.size_op(), hvd_tf.rank_op()
+
+    s, r = fn()
+    assert int(s) == hvd_tf.size()
+    assert int(r) == hvd_tf.rank()
+
+
+def test_distributed_gradient_tape(hvd_tf):
+    x = tf.Variable([1.0, 2.0])
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        y = tf.reduce_sum(x * x)
+    grads = tape.gradient(y, [x])
+    np.testing.assert_allclose(grads[0].numpy(), [2.0, 4.0])
+
+
+def test_broadcast_variables(hvd_tf):
+    v = tf.Variable([1.0, 2.0, 3.0])
+    hvd_tf.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_broadcast_and_allgather_object(hvd_tf):
+    obj = {"epoch": 3, "name": "x"}
+    assert hvd_tf.broadcast_object(obj, 0, name="tfobj") == obj
+    assert hvd_tf.allgather_object(obj, name="tfobjs") == [obj]
+
+
+def _make_model():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    return model
+
+
+def test_keras_distributed_optimizer_fit(hvd_tf):
+    import horovod_tpu.keras as hk
+    model = _make_model()
+    opt = hk.DistributedOptimizer(keras.optimizers.SGD(0.01))
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    x = np.random.randn(16, 4).astype(np.float32)
+    y = np.random.randn(16, 2).astype(np.float32)
+    before = model.get_weights()[0].copy()
+    cb = [hk.callbacks.BroadcastGlobalVariablesCallback(0),
+          hk.callbacks.MetricAverageCallback()]
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0, callbacks=cb)
+    after = model.get_weights()[0]
+    assert not np.allclose(before, after)
+
+
+def test_keras_lr_callbacks(hvd_tf):
+    import horovod_tpu.keras as hk
+    model = _make_model()
+    opt = keras.optimizers.SGD(0.1)
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randn(8, 2).astype(np.float32)
+    sched = hk.callbacks.LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=0.5, start_epoch=0, staircase=True)
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0, callbacks=[sched])
+    assert np.isclose(float(np.asarray(opt.learning_rate)), 0.05)
+
+    warm = hk.callbacks.LearningRateWarmupCallback(
+        initial_lr=0.1, warmup_epochs=2, steps_per_epoch=1)
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0, callbacks=[warm])
+    # size()==1 → multiplier is 1 → lr back to initial
+    assert np.isclose(float(np.asarray(opt.learning_rate)), 0.1)
+
+
+def test_sync_batch_norm_single(hvd_tf):
+    layer = hvd_tf.SyncBatchNormalization(axis=-1)
+    x = tf.random.normal([16, 4])
+    out = layer(x, training=True)
+    got = out.numpy()
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got.mean(axis=0), np.zeros(4), atol=1e-3)
+    np.testing.assert_allclose(got.std(axis=0), np.ones(4), atol=2e-2)
+
+
+def test_keras_elastic_state(hvd_tf):
+    import horovod_tpu.keras.elastic as ke
+    model = _make_model()
+    model.compile(optimizer=keras.optimizers.SGD(0.01), loss="mse")
+    state = ke.KerasState(model, epoch=0)
+    w0 = model.get_weights()[0].copy()
+    state.commit()
+    model.set_weights([w * 0 for w in model.get_weights()])
+    state.restore()
+    np.testing.assert_allclose(model.get_weights()[0], w0)
+    state.epoch = 5
+    state.save()
+    state.sync()
+    assert state.epoch == 5
