@@ -19,6 +19,40 @@ from .. import ops as _ops
 from ..ops.compression import Compression
 
 
+def _backend_grads_fn(compression, op, gradient_predivide_factor,
+                      process_set):
+    """Backend-neutral gradient reduction via keras.ops conversion —
+    used when TensorFlow is not installed (Keras on the JAX backend)."""
+    from keras import ops as K
+    from .. import ops as _ops
+
+    def allreduce_grads(grads, variables=None):
+        if op == Average:
+            prescale = 1.0 / gradient_predivide_factor
+            postscale = gradient_predivide_factor / process_set.size()
+            reduce_op = Sum
+        else:
+            prescale, postscale, reduce_op = 1.0, 1.0, op
+        index = [i for i, g in enumerate(grads) if g is not None]
+        arrs = [np.asarray(K.convert_to_numpy(grads[i])) for i in index]
+        compressed, ctxs = [], []
+        for a in arrs:
+            c, ctx = compression.compress(a)
+            compressed.append(c)
+            ctxs.append(ctx)
+        reduced = _ops.grouped_allreduce(
+            compressed, op=reduce_op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=process_set) \
+            if compressed else []
+        out = list(grads)
+        for i, r, ctx in zip(index, reduced, ctxs):
+            out[i] = K.convert_to_tensor(
+                np.asarray(compression.decompress(r, ctx)))
+        return out
+
+    return allreduce_grads
+
+
 def create_distributed_optimizer(optimizer, name=None,
                                  compression=Compression.none,
                                  sparse_as_dense=False,
@@ -29,12 +63,19 @@ def create_distributed_optimizer(optimizer, name=None,
                                  process_set=global_process_set,
                                  make_allreduce_grads_fn=None):
     if make_allreduce_grads_fn is None:
-        from ..tensorflow import _make_allreduce_grads_fn as _fn
-        make_allreduce_grads_fn = _fn
-    allreduce_grads = make_allreduce_grads_fn(
-        name or "DistributedOptimizer", "", "", compression,
-        sparse_as_dense, op, gradient_predivide_factor, num_groups,
-        process_set)
+        try:
+            from ..tensorflow import _make_allreduce_grads_fn as _fn
+            make_allreduce_grads_fn = _fn
+        except ImportError:
+            make_allreduce_grads_fn = None
+    if make_allreduce_grads_fn is not None:
+        allreduce_grads = make_allreduce_grads_fn(
+            name or "DistributedOptimizer", "", "", compression,
+            sparse_as_dense, op, gradient_predivide_factor, num_groups,
+            process_set)
+    else:
+        allreduce_grads = _backend_grads_fn(
+            compression, op, gradient_predivide_factor, process_set)
 
     cls = optimizer.__class__
 
@@ -42,12 +83,16 @@ def create_distributed_optimizer(optimizer, name=None,
         _hvd_distributed = True
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            import tensorflow as tf
+            try:
+                import tensorflow as tf
+                eager = tf.executing_eagerly()
+            except ImportError:
+                eager = True
             grads_and_vars = list(grads_and_vars)
             grads = [g for g, _ in grads_and_vars]
             variables = [v for _, v in grads_and_vars]
             if self._hvd_backward_passes > 1:
-                if not tf.executing_eagerly():
+                if not eager:
                     raise NotImplementedError(
                         "backward_passes_per_step > 1 requires eager "
                         "execution (compile with run_eagerly=True); the "

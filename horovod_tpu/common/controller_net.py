@@ -75,13 +75,20 @@ class CoordinatorServer:
     def __init__(self, size: int, bind_addr: str = "0.0.0.0",
                  port: int = 0, fusion_threshold: int = 64 << 20,
                  timeline=None, elastic: bool = False,
-                 allow_ephemeral_fallback: bool = False):
+                 allow_ephemeral_fallback: bool = False,
+                 param_manager=None):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
         self.elastic = elastic
         self.allow_ephemeral_fallback = allow_ephemeral_fallback
         self._broken = False
+        # Autotuner (rank-0 only: fusion planning happens here, so the
+        # threshold needs no cross-rank sync — reference
+        # parameter_manager.cc semantics, SURVEY §2.1).
+        self.param_manager = param_manager
+        if param_manager is not None:
+            param_manager.fusion_threshold_bytes = fusion_threshold
         self._table = MessageTable()
         # tensor name -> element count, for fusion byte accounting
         self._elem_cache: Dict[str, int] = {}
@@ -292,6 +299,15 @@ class CoordinatorServer:
             fused = fuse_responses(ready, self._elem_cache,
                                    self.fusion_threshold)
             self._broadcast_locked(fused)
+            if self.param_manager is not None and \
+                    self.param_manager.active:
+                nbytes = sum(
+                    self._elem_cache.get(name, 0) *
+                    dtype_size(resp.tensor_type)
+                    for resp in fused for name in resp.tensor_names)
+                self.param_manager.record_step(nbytes)
+                self.fusion_threshold = \
+                    self.param_manager.fusion_threshold_bytes
 
     def stop(self):
         self._stop.set()
@@ -323,13 +339,28 @@ class NetworkController(Controller):
             port = 0
             if addr and ":" in addr:
                 port = int(addr.rsplit(":", 1)[1])
+            param_manager = None
+            if state.knobs.autotune:
+                from .parameter_manager import ParameterManager
+                param_manager = ParameterManager(
+                    warmup_samples=state.knobs.autotune_warmup_samples,
+                    steps_per_sample=state.knobs.autotune_steps_per_sample,
+                    bayes_opt_max_samples=(
+                        state.knobs.autotune_bayes_opt_max_samples),
+                    gp_noise=state.knobs.autotune_gaussian_process_noise,
+                    initial_fusion_bytes=(
+                        state.knobs.fusion_threshold_bytes),
+                    initial_cycle_ms=state.knobs.cycle_time_ms,
+                    log_path=state.knobs.autotune_log)
+                state.parameter_manager = param_manager
             self.server = CoordinatorServer(
                 self.size, port=port,
                 fusion_threshold=state.knobs.fusion_threshold_bytes,
                 timeline=state.timeline,
                 elastic=state.knobs.elastic,
                 allow_ephemeral_fallback=(
-                    self._rendezvous_client() is not None))
+                    self._rendezvous_client() is not None),
+                param_manager=param_manager)
             self._publish_actual_addr(addr, self.server.port)
             host = "127.0.0.1"
             self._addr = (host, self.server.port)

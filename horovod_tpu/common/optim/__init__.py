@@ -1,0 +1,8 @@
+"""Optimization utilities for the autotuner (reference:
+horovod/common/optim/ — Gaussian-process regression + Bayesian
+optimization with Expected Improvement)."""
+
+from .gaussian_process import GaussianProcessRegressor
+from .bayesian_optimization import BayesianOptimization
+
+__all__ = ["GaussianProcessRegressor", "BayesianOptimization"]

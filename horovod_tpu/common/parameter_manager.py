@@ -1,0 +1,121 @@
+"""Autotuner: Bayesian optimization of runtime knobs from live
+throughput.
+
+Reference: common/parameter_manager.{h,cc} (251+528) — tunables scored
+by bytes/sec over sampling windows, warmup samples discarded, best
+params adopted when tuning converges; joint fusion-threshold ×
+cycle-time search via GP + Expected Improvement
+(BayesianParameter :186-220).
+
+TPU-native deltas:
+  * fusion planning happens ONLY on the rank-0 coordinator (workers
+    execute broadcast fused batches), so the fusion threshold needs no
+    cross-rank synchronization protocol — the manager lives in the
+    CoordinatorServer and retunes its threshold in place;
+  * the reference's cycle-time knob exists because its background loop
+    polls on a fixed cadence (operations.cc:587 1 ms sleep); this
+    runtime is event-driven (wakes on submit), so there is no polling
+    cadence to tune — the search space is fusion threshold only, and
+    ``cycle_time_ms`` is carried for API parity but fixed.
+"""
+
+import logging
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .optim.bayesian_optimization import BayesianOptimization
+
+logger = logging.getLogger("horovod_tpu.autotune")
+
+MB = 1024 * 1024
+
+FUSION_MB_BOUNDS = (1.0, 128.0)
+
+
+class ParameterManager:
+    def __init__(self, warmup_samples: int = 3,
+                 steps_per_sample: int = 10,
+                 bayes_opt_max_samples: int = 20,
+                 gp_noise: float = 0.8,
+                 initial_fusion_bytes: int = 64 * MB,
+                 initial_cycle_ms: float = 1.0,
+                 log_path: Optional[str] = None,
+                 on_update: Optional[Callable] = None):
+        self._warmup_remaining = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._max_samples = bayes_opt_max_samples
+        self._on_update = on_update
+        self._bo = BayesianOptimization(
+            bounds=[FUSION_MB_BOUNDS], gp_noise=gp_noise)
+        self.fusion_threshold_bytes = initial_fusion_bytes
+        self.cycle_time_ms = initial_cycle_ms   # API parity; fixed
+        self._current = np.array([initial_fusion_bytes / MB])
+        self._samples_taken = 0
+        self._steps = 0
+        self._bytes = 0
+        self._window_start = time.monotonic()
+        self._done = False
+        self._log = open(log_path, "w") if log_path else None
+        if self._log:
+            self._log.write("sample,fusion_mb,score_bytes_per_sec,"
+                            "is_best\n")
+
+    @property
+    def active(self) -> bool:
+        return not self._done
+
+    def record_step(self, nbytes: int):
+        """One negotiation round completed, moving ``nbytes`` of fused
+        tensor payload.  Drives the sampling window."""
+        if self._done:
+            return
+        self._bytes += nbytes
+        self._steps += 1
+        if self._steps < self._steps_per_sample:
+            return
+        elapsed = max(time.monotonic() - self._window_start, 1e-6)
+        score = self._bytes / elapsed
+        self._steps = 0
+        self._bytes = 0
+        self._window_start = time.monotonic()
+        self._advance(score)
+
+    def _advance(self, score: float):
+        if self._warmup_remaining > 0:
+            # Warmup windows pollute the score (compilation, cold
+            # caches); discard them (reference warmup discard).
+            self._warmup_remaining -= 1
+            return
+        self._bo.add_sample(self._current, score)
+        self._samples_taken += 1
+        best = self._bo.best
+        is_best = best is not None and np.allclose(best[0],
+                                                   self._current)
+        if self._log:
+            self._log.write(
+                f"{self._samples_taken},{self._current[0]:.2f},"
+                f"{score:.1f},{int(bool(is_best))}\n")
+            self._log.flush()
+        if self._samples_taken >= self._max_samples:
+            # Converged: adopt the best-observed parameters for the
+            # rest of the run.
+            params, best_score = best
+            self._apply(params)
+            self._done = True
+            logger.info(
+                "autotune converged: fusion=%.1fMB (%.1f MB/s)",
+                params[0], best_score / MB)
+            if self._log:
+                self._log.close()
+                self._log = None
+            return
+        self._apply(self._bo.next_sample())
+
+    def _apply(self, params):
+        self._current = np.asarray(params, dtype=np.float64)
+        self.fusion_threshold_bytes = int(self._current[0] * MB)
+        if self._on_update:
+            self._on_update(self.fusion_threshold_bytes,
+                            self.cycle_time_ms)

@@ -146,9 +146,12 @@ class ElasticDriver:
         return self._shutdown.is_set()
 
     def join(self, timeout: Optional[float] = None) -> bool:
-        """Wait until the run finishes; returns True on clean finish."""
+        """Wait until the run finishes; returns True on clean finish,
+        False when the timeout expired or the run errored."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        self._shutdown.wait(timeout)
+        finished = self._shutdown.wait(timeout)
+        if not finished:
+            return False
         # Let worker monitor threads drain.
         for lw in list(self._live.values()):
             t = None if deadline is None else max(0.0,

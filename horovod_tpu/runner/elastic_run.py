@@ -53,16 +53,15 @@ def launch_elastic(command: List[str],
                            reset_limit=reset_limit, verbose=verbose)
     server._httpd.elastic_driver = driver
 
-    driver_ip = None  # resolved lazily once hosts are known
-
     run_command = " ".join(shlex.quote(c) for c in command)
     base_env = dict(env or os.environ)
 
     def create_worker(slot: SlotInfo) -> int:
-        nonlocal driver_ip
         local = is_local(slot.hostname)
-        if driver_ip is None:
-            driver_ip = "127.0.0.1" if local else local_addresses()[0]
+        # Per-worker: a local worker reaches the rendezvous via
+        # loopback, a remote one needs this host's routable address —
+        # resolved per spawn since hosts join over time.
+        driver_ip = "127.0.0.1" if local else local_addresses()[0]
         worker_env = {
             "HOROVOD_ELASTIC": "1",
             "HOROVOD_HOSTNAME": slot.hostname,

@@ -5,9 +5,11 @@ computed across ALL ranks by allreducing the stacked
 [mean, mean-of-squares] so every worker normalizes with global batch
 statistics (essential when per-worker batches are small).
 
-Implemented as a Keras-3 layer: local moments → one stacked-moment
-allreduce (Average) → global mean/var → normalize.  Inference uses the
-moving statistics like plain BatchNormalization.
+Implemented as a Keras layer on the TensorFlow backend: local moments →
+one stacked-moment allreduce (Average, via the binding's graph-aware
+op, so tf.function traces get a tf.py_function node) → global mean/var
+→ normalize.  Inference uses the moving statistics like plain
+BatchNormalization.
 """
 
 import numpy as np
@@ -16,11 +18,12 @@ from keras import ops as K
 
 from ..common import basics
 from ..common.basics import Average, global_process_set
-from .. import ops as _ops
 
 
 class SyncBatchNormalization(keras.layers.BatchNormalization):
-    """Drop-in BatchNormalization with cross-rank batch statistics."""
+    """Drop-in BatchNormalization with cross-rank batch statistics.
+    Requires the TensorFlow Keras backend (the JAX-backend equivalent
+    is horovod_tpu.parallel's in-graph statistics)."""
 
     def __init__(self, process_set=global_process_set, **kwargs):
         super().__init__(**kwargs)
@@ -29,6 +32,12 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
     def call(self, inputs, training=None, mask=None):
         if not training or self._process_set.size() == 1:
             return super().call(inputs, training=training, mask=mask)
+        if keras.backend.backend() != "tensorflow":
+            raise RuntimeError(
+                "horovod_tpu.tensorflow.SyncBatchNormalization requires "
+                "the TensorFlow Keras backend; on JAX use the in-graph "
+                "mesh statistics (horovod_tpu.parallel).")
+        from . import allreduce as tf_allreduce
 
         x = K.convert_to_tensor(inputs)
         ndim = len(x.shape)
@@ -38,13 +47,12 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
         local_mean = K.mean(x, axis=reduce_axes)
         local_sq_mean = K.mean(K.square(x), axis=reduce_axes)
         # One fused allreduce of the stacked moments (reference
-        # stacks mean and mean-of-squares into a single tensor).
+        # stacks mean and mean-of-squares into a single tensor);
+        # tf_allreduce handles both eager and tf.function tracing.
         stacked = K.stack([local_mean, local_sq_mean])
-        reduced = _ops.allreduce(
-            np.asarray(stacked), op=Average,
-            name=f"sync_bn/{self.name}",
-            process_set=self._process_set)
-        reduced = K.convert_to_tensor(np.asarray(reduced))
+        reduced = tf_allreduce(stacked, op=Average,
+                               name=f"sync_bn/{self.name}",
+                               process_set=self._process_set)
         mean = reduced[0]
         var = reduced[1] - K.square(mean)
 

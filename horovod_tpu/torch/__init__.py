@@ -1,0 +1,349 @@
+"""PyTorch framework binding.
+
+The compatibility surface of the reference's ``horovod.torch``
+(reference: torch/mpi_ops.py:85-846 async handle API, torch/optimizer.py
+hook-based DistributedOptimizer, torch/functions.py broadcast helpers,
+torch/sync_batch_norm.py, torch/elastic/).
+
+TPU-native design note: CPU torch tensors stage through host memory into
+the background runtime — the exact analog of the reference's
+``*CudaOnCPU`` staged variants (torch/mpi_ops_v2.cc:93-127); the
+compiled TPU training path lives in :mod:`horovod_tpu.jax` /
+:mod:`horovod_tpu.training`.  Handles returned by the async API are
+:class:`horovod_tpu.ops.Handle` futures; ``synchronize`` maps to the
+reference's handle-table WaitForCompletion (torch/mpi_ops.py:823-846).
+"""
+
+from typing import Any, List, Optional
+
+import numpy as np
+import torch
+
+from ..common import basics
+from ..common.basics import (Adasum, Average, Max, Min, Product, Sum,
+                             ProcessSet, global_process_set, init,
+                             is_homogeneous, is_initialized, local_rank,
+                             local_size, cross_rank, cross_size,
+                             mpi_built, mpi_enabled, gloo_built,
+                             gloo_enabled, nccl_built, rank, shutdown,
+                             size, start_timeline, stop_timeline)
+from ..common.exceptions import HorovodInternalError
+from .. import ops as _ops
+from ..ops import Handle, poll
+from .compression import Compression
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size", "is_initialized", "is_homogeneous",
+    "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled",
+    "nccl_built", "start_timeline", "stop_timeline",
+    "Average", "Sum", "Adasum", "Min", "Max", "Product", "Compression",
+    "ProcessSet", "global_process_set",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async",
+    "synchronize", "poll", "join", "barrier",
+    "DistributedOptimizer", "broadcast_parameters",
+    "broadcast_optimizer_state", "broadcast_object", "allgather_object",
+    "SyncBatchNorm", "elastic",
+]
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy()
+
+
+def _to_torch(arr, like: Optional[torch.Tensor] = None) -> torch.Tensor:
+    t = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
+    if like is not None and t.dtype != like.dtype:
+        t = t.to(like.dtype)
+    return t
+
+
+def synchronize(handle: Handle):
+    """Wait for an async op; failed collectives raise
+    HorovodInternalError (reference: torch/mpi_ops.py:823-846)."""
+    return handle.wait()
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+def _allreduce_async_np(tensor, name, op, prescale_factor,
+                        postscale_factor, process_set,
+                        compression=Compression.none):
+    arr = _to_numpy(tensor)
+    compressed, ctx = compression.compress(arr)
+    inner = _ops.allreduce_async(
+        compressed, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)
+    return _TorchHandle(inner, tensor, ctx, compression)
+
+
+class _TorchHandle(Handle):
+    """Wraps an ops.Handle, converting the numpy result back to a torch
+    tensor (decompressing first) and optionally copying in-place."""
+
+    def __init__(self, inner: Handle, like: torch.Tensor, ctx,
+                 compression, inplace_target: Optional[torch.Tensor] = None):
+        self._inner = inner
+        self._like = like
+        self._ctx = ctx
+        self._compression = compression
+        self._inplace = inplace_target
+        self.name = inner.name
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def wait(self, timeout: Optional[float] = None):
+        result = self._inner.wait(timeout)
+        if isinstance(result, tuple):   # alltoall with splits
+            out, splits = result
+            return (_to_torch(out, self._like),
+                    _to_torch(np.asarray(splits)) if splits is not None
+                    else None)
+        result = self._compression.decompress(np.asarray(result),
+                                              self._ctx)
+        t = _to_torch(result, self._like)
+        if self._inplace is not None:
+            self._inplace.copy_(t.reshape(self._inplace.shape))
+            return self._inplace
+        return t
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=global_process_set,
+                    compression=Compression.none) -> Handle:
+    return _allreduce_async_np(tensor, name, _resolve(op, average),
+                               prescale_factor, postscale_factor,
+                               process_set, compression)
+
+
+def _resolve(op, average):
+    if op is not None and average is not None:
+        raise ValueError("Cannot specify both 'op' and deprecated "
+                         "'average' arguments.")
+    if op is None:
+        return Average if (average is None or average) else Sum
+    return op
+
+
+def allreduce(tensor, average=None, name=None, compression=Compression.none,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
+              process_set=global_process_set) -> torch.Tensor:
+    if tensor.requires_grad:
+        return _AllreduceFunction.apply(
+            tensor, name, _resolve(op, average), prescale_factor,
+            postscale_factor, process_set)
+    return synchronize(allreduce_async(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set,
+        compression=compression))
+
+
+class _AllreduceFunction(torch.autograd.Function):
+    """Differentiable allreduce (reference: torch/mpi_ops.py:163
+    HorovodAllreduce autograd.Function)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name, op, prescale, postscale, process_set):
+        ctx.op = op
+        ctx.prescale = prescale
+        ctx.postscale = postscale
+        ctx.process_set = process_set
+        h = _allreduce_async_np(tensor, name, op, prescale, postscale,
+                                process_set)
+        return h.wait()
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        h = _allreduce_async_np(grad_output, None, ctx.op, ctx.prescale,
+                                ctx.postscale, ctx.process_set)
+        return h.wait(), None, None, None, None, None
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=global_process_set) -> Handle:
+    """In-place async allreduce: the result is copied back into
+    ``tensor`` on synchronize (reference allreduce_async_)."""
+    arr = _to_numpy(tensor)
+    inner = _ops.allreduce_async(
+        arr, name=name, op=_resolve(op, average),
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)
+    return _TorchHandle(inner, tensor, None, Compression.none,
+                        inplace_target=tensor)
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=global_process_set) -> torch.Tensor:
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=global_process_set) -> List[Handle]:
+    arrs = [_to_numpy(t) for t in tensors]
+    inners = _ops.grouped_allreduce_async(
+        arrs, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)
+    return [_TorchHandle(h, t, None, Compression.none)
+            for h, t in zip(inners, tensors)]
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set) -> List[torch.Tensor]:
+    return [h.wait() for h in grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)]
+
+
+# ---------------------------------------------------------------------------
+# allgather / broadcast / alltoall / reducescatter
+# ---------------------------------------------------------------------------
+def allgather_async(tensor, name=None,
+                    process_set=global_process_set) -> Handle:
+    inner = _ops.allgather_async(_to_numpy(tensor), name=name,
+                                 process_set=process_set)
+    return _TorchHandle(inner, tensor, None, Compression.none)
+
+
+def allgather(tensor, name=None,
+              process_set=global_process_set) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=global_process_set) -> Handle:
+    inner = _ops.broadcast_async(_to_numpy(tensor), root_rank, name=name,
+                                 process_set=process_set)
+    return _TorchHandle(inner, tensor, None, Compression.none)
+
+
+def broadcast(tensor, root_rank, name=None,
+              process_set=global_process_set) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name,
+                                       process_set))
+
+
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=global_process_set) -> Handle:
+    inner = _ops.broadcast_async(_to_numpy(tensor), root_rank, name=name,
+                                 process_set=process_set)
+    return _TorchHandle(inner, tensor, None, Compression.none,
+                        inplace_target=tensor)
+
+
+def broadcast_(tensor, root_rank, name=None,
+               process_set=global_process_set) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name,
+                                        process_set))
+
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=global_process_set) -> Handle:
+    np_splits = _to_numpy(splits) if isinstance(splits, torch.Tensor) \
+        else splits
+    inner = _ops.alltoall_async(_to_numpy(tensor), np_splits, name=name,
+                                process_set=process_set)
+    return _TorchHandle(inner, tensor, None, Compression.none)
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    result = synchronize(alltoall_async(tensor, splits, name,
+                                        process_set))
+    out, recv_splits = result
+    if splits is None:
+        return out
+    return out, recv_splits
+
+
+def reducescatter_async(tensor, op=None, name=None,
+                        process_set=global_process_set) -> Handle:
+    inner = _ops.reducescatter_async(_to_numpy(tensor), name=name, op=op,
+                                     process_set=process_set)
+    return _TorchHandle(inner, tensor, None, Compression.none)
+
+
+def reducescatter(tensor, op=None, name=None,
+                  process_set=global_process_set) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, op, name, process_set))
+
+
+def join(device=None) -> int:
+    """Block until every rank has joined; returns the last-joined rank
+    (reference: torch/mpi_ops.py:846-870)."""
+    return _ops.join()
+
+
+def barrier(process_set=global_process_set):
+    return _ops.barrier(process_set)
+
+
+# ---------------------------------------------------------------------------
+# parameter / object broadcast (reference: torch/functions.py:29-262)
+# ---------------------------------------------------------------------------
+def broadcast_parameters(params, root_rank=0,
+                         process_set=global_process_set):
+    """In-place broadcast of an iterable of (name, tensor) or a
+    state_dict (reference: torch/functions.py:29-67)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append(broadcast_async_(p, root_rank,
+                                        name=f"bparam/{name}",
+                                        process_set=process_set))
+    for h in handles:
+        h.wait()
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0,
+                              process_set=global_process_set):
+    """Broadcast an optimizer's state_dict from root (reference:
+    torch/functions.py:69-184)."""
+    state_dict = optimizer.state_dict()
+    # Non-root ranks may have empty state (created lazily at first
+    # step): materialize it from the root's pickled structure.
+    full = broadcast_object(state_dict, root_rank,
+                            name="opt_state_dict",
+                            process_set=process_set)
+    if basics.rank() != root_rank:
+        optimizer.load_state_dict(full)
+
+
+def broadcast_object(obj=None, root_rank=0, name="broadcast_object",
+                     process_set=global_process_set):
+    from ..jax import broadcast_object as _bo
+    return _bo(obj, root_rank, name=name, process_set=process_set)
+
+
+def allgather_object(obj, name="allgather_object",
+                     process_set=global_process_set):
+    from ..jax import allgather_object as _ao
+    return _ao(obj, name=name, process_set=process_set)
+
+
+from .optimizer import (DistributedOptimizer,              # noqa: E402
+                        _DistributedOptimizer,
+                        _DistributedAdasumOptimizer)
+from .sync_batch_norm import SyncBatchNorm                 # noqa: E402
+from . import elastic                                      # noqa: E402

@@ -1,0 +1,7 @@
+"""Elastic training for PyTorch (reference: torch/elastic/ —
+``TorchState`` with model/optimizer handlers and ``ElasticSampler``)."""
+
+from .sampler import ElasticSampler
+from .state import TorchState, run
+
+__all__ = ["TorchState", "ElasticSampler", "run"]

@@ -74,6 +74,8 @@ def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
             "HOROVOD_TPU_FORCE_CPU": "1",
             "PYTHONPATH": REPO,
         })
+        if extra_env:
+            env.update(extra_env)
         env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code], env=env,

@@ -1,0 +1,39 @@
+"""Multi-process PyTorch binding test: gradients reduce across ranks
+through the hook-based DistributedOptimizer (2 real processes, the
+reference's parallel-test technique)."""
+
+from multiproc import assert_all_ok, run_workers
+
+BODY = """
+import torch
+import horovod_tpu.torch as ht
+
+x = torch.ones(4) * (RANK + 1)
+out = ht.allreduce(x, op=ht.Sum, name="t0")
+assert torch.allclose(out, torch.ones(4) * 3), out
+
+# hook-based optimizer: ranks have different grads; after step all
+# ranks hold identical (averaged) weights.
+torch.manual_seed(RANK)
+model = torch.nn.Linear(4, 1, bias=False)
+ht.broadcast_parameters(model.state_dict(), root_rank=0)
+opt = ht.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.5),
+    named_parameters=model.named_parameters())
+data = torch.full((2, 4), float(RANK + 1))
+opt.zero_grad()
+model(data).sum().backward()
+opt.step()
+w = model.weight.detach().numpy()
+import numpy as np
+allw = np.asarray(ht.allgather(model.weight.detach(), name="wg"))
+assert np.allclose(allw[0], allw[1]), (allw,)
+print("TORCH-MP OK", RANK)
+"""
+
+
+def test_torch_distributed_optimizer_2proc():
+    results = run_workers(BODY, nproc=2)
+    assert_all_ok(results)
+    for _, out in results:
+        assert "TORCH-MP OK" in out

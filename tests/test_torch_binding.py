@@ -1,0 +1,211 @@
+"""PyTorch binding tests (single-process; multi-process collectives are
+covered through the shared runtime).  Mirrors reference
+test/parallel/test_torch.py coverage style at world size 1."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def hvd_t():
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    yield hvd
+
+
+def test_allreduce(hvd_t):
+    x = torch.tensor([1.0, 2.0, 3.0])
+    out = hvd_t.allreduce(x, op=hvd_t.Sum)
+    assert torch.allclose(out, x)
+    out = hvd_t.allreduce(x, op=hvd_t.Average)
+    assert torch.allclose(out, x)
+    assert out.dtype == x.dtype
+
+
+def test_allreduce_inplace(hvd_t):
+    x = torch.tensor([2.0, 4.0])
+    y = hvd_t.allreduce_(x, op=hvd_t.Sum, prescale_factor=0.5)
+    assert y is x
+    assert torch.allclose(x, torch.tensor([1.0, 2.0]))
+
+
+def test_allreduce_async_poll(hvd_t):
+    x = torch.ones(4)
+    h = hvd_t.allreduce_async(x, name="apoll")
+    out = hvd_t.synchronize(h)
+    assert hvd_t.poll(h)
+    assert torch.allclose(out, x)
+
+
+def test_allreduce_autograd(hvd_t):
+    x = torch.tensor([1.0, 2.0], requires_grad=True)
+    y = hvd_t.allreduce(x, op=hvd_t.Sum)
+    y.sum().backward()
+    assert torch.allclose(x.grad, torch.ones(2))
+
+
+def test_grouped_allreduce(hvd_t):
+    xs = [torch.ones(3), torch.full((2,), 2.0)]
+    outs = hvd_t.grouped_allreduce(xs, op=hvd_t.Average)
+    assert torch.allclose(outs[0], xs[0])
+    assert torch.allclose(outs[1], xs[1])
+
+
+def test_allgather_broadcast_alltoall(hvd_t):
+    x = torch.arange(6, dtype=torch.int64)
+    assert torch.equal(hvd_t.allgather(x), x)
+    assert torch.equal(hvd_t.broadcast(x, 0), x)
+    y = torch.zeros(3)
+    hvd_t.broadcast_(y, 0)
+    assert torch.equal(y, torch.zeros(3))
+    assert torch.equal(hvd_t.alltoall(x), x)
+
+
+def test_dtypes(hvd_t):
+    for dtype in (torch.float16, torch.float32, torch.float64,
+                  torch.int32, torch.int64, torch.uint8):
+        x = torch.ones(4, dtype=dtype)
+        out = hvd_t.allreduce(x, op=hvd_t.Sum)
+        assert out.dtype == dtype, dtype
+
+
+def test_join(hvd_t):
+    assert hvd_t.join() == 0
+
+
+def test_broadcast_parameters_and_optimizer_state(hvd_t):
+    model = torch.nn.Linear(4, 2)
+    hvd_t.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    # Materialize optimizer state with one step.
+    model(torch.randn(2, 4)).sum().backward()
+    opt.step()
+    hvd_t.broadcast_optimizer_state(opt, root_rank=0)
+
+
+def test_broadcast_object_allgather_object(hvd_t):
+    obj = {"a": 1, "b": [2, 3]}
+    assert hvd_t.broadcast_object(obj, 0, name="tobj") == obj
+    assert hvd_t.allgather_object(obj, name="tobjs") == [obj]
+
+
+def test_distributed_optimizer_step(hvd_t):
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                torch.nn.Linear(8, 1))
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    x = torch.randn(16, 4)
+    y = torch.randn(16, 1)
+    losses = []
+    for _ in range(10):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert isinstance(opt, torch.optim.SGD)
+
+
+def test_distributed_optimizer_backward_passes(hvd_t):
+    model = torch.nn.Linear(2, 1)
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    x = torch.randn(4, 2)
+    w0 = model.weight.detach().clone()
+    for i in range(2):
+        model(x).sum().backward()
+    opt.step()
+    assert not torch.allclose(model.weight, w0)
+
+
+def test_adasum_optimizer(hvd_t):
+    model = torch.nn.Linear(2, 1)
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(), op=hvd_t.Adasum)
+    w0 = model.weight.detach().clone()
+    model(torch.randn(4, 2)).sum().backward()
+    opt.step()
+    assert not torch.allclose(model.weight, w0)
+
+
+def test_sync_batch_norm_single(hvd_t):
+    bn = hvd_t.SyncBatchNorm(4)
+    bn.train()
+    x = torch.randn(16, 4)
+    out = bn(x)
+    assert torch.isfinite(out).all()
+
+
+def test_torch_state_save_restore(hvd_t):
+    from horovod_tpu.torch.elastic import TorchState
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = TorchState(model=model, optimizer=opt, epoch=1)
+    w0 = model.weight.detach().clone()
+    state.commit()
+    with torch.no_grad():
+        model.weight.zero_()
+    state.restore()
+    assert torch.allclose(model.weight, w0)
+    assert state.epoch == 1
+    state.sync()
+    assert torch.allclose(model.weight, w0)
+
+
+def test_elastic_sampler(hvd_t):
+    from horovod_tpu.torch.elastic import ElasticSampler
+
+    class DS:
+        def __len__(self):
+            return 10
+
+    s = ElasticSampler(DS(), shuffle=False)
+    idx = list(iter(s))
+    assert sorted(idx) == list(range(10))
+    # Process the first 2 batches of 2 and reset: remaining excludes
+    # them.
+    s.record_batch(0, 2)
+    s.record_batch(1, 2)
+    s.reset()
+    remaining = list(iter(s))
+    assert sorted(remaining) == list(range(4, 10))
+    st = s.state_dict()
+    s2 = ElasticSampler(DS(), shuffle=False)
+    s2.load_state_dict(st)
+    assert sorted(iter(s2)) == list(range(4, 10))
+
+
+def test_sync_batch_norm_gradients_match_batchnorm(hvd_t):
+    """At world size 1 the custom sync-BN function must reproduce
+    torch BatchNorm's forward AND backward exactly."""
+    from horovod_tpu.torch.sync_batch_norm import _SyncBatchNormFn
+    from horovod_tpu.common.basics import global_process_set
+    torch.manual_seed(3)
+    x1 = torch.randn(8, 4, requires_grad=True)
+    x2 = x1.detach().clone().requires_grad_(True)
+    w = torch.randn(4, requires_grad=True)
+    b = torch.randn(4, requires_grad=True)
+    w2 = w.detach().clone().requires_grad_(True)
+    b2 = b.detach().clone().requires_grad_(True)
+
+    out1, _, _ = _SyncBatchNormFn.apply(x1, w, b, 1e-5,
+                                        global_process_set, 999)
+    ref = torch.nn.functional.batch_norm(
+        x2, None, None, w2, b2, training=True, eps=1e-5)
+    assert torch.allclose(out1, ref, atol=1e-5)
+
+    g = torch.randn(8, 4)
+    out1.backward(g)
+    ref.backward(g)
+    assert torch.allclose(x1.grad, x2.grad, atol=1e-4), \
+        (x1.grad - x2.grad).abs().max()
+    assert torch.allclose(w.grad, w2.grad, atol=1e-4)
+    assert torch.allclose(b.grad, b2.grad, atol=1e-4)
