@@ -1,0 +1,65 @@
+"""Adasum BERT pretraining example (reference: examples/adasum/ and
+docs/adasum_user_guide.rst — Adasum combines gradients with the
+scale-invariant pairwise rule instead of averaging, allowing larger
+effective learning rates at scale).
+
+Run:  horovodrun -np 2 python adasum_bert_pretrain.py --steps 3 --tiny
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hj
+from horovod_tpu.models.bert import (BertForMaskedLM, bert_large_config,
+                                     bert_tiny_config, mlm_loss)
+from horovod_tpu.training import make_bert_batch
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=1e-4)
+    parser.add_argument("--tiny", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    config = bert_tiny_config() if args.tiny else bert_large_config()
+    model = BertForMaskedLM(config)
+
+    rng = jax.random.PRNGKey(0)
+    batch = make_bert_batch(args.batch_size,
+                            min(args.seq_len,
+                                config.max_position_embeddings),
+                            config.vocab_size, seed=hvd.rank())
+    params = model.init(rng, batch["input_ids"])
+    # Adasum needs no lr scaling by world size (reference
+    # docs/adasum_user_guide.rst).
+    tx = hj.DistributedOptimizer(optax.adamw(args.lr), op=hvd.Adasum)
+    params = hj.broadcast_parameters(params, root_rank=0)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def loss_and_grads(params, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["input_ids"],
+                                 deterministic=True)
+            return mlm_loss(logits, batch["labels"], batch["mask"])
+        return jax.value_and_grad(loss_fn)(params)
+
+    for step in range(args.steps):
+        loss, grads = loss_and_grads(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if hvd.rank() == 0:
+            print(f"step {step} loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Elastic JAX training (reference: examples/elastic/tensorflow2/ —
+BASELINE.md elastic config, on the flagship binding).
+
+Run with a host-discovery script whose output may change over time:
+
+    horovodrun -np 2 --min-np 2 --max-np 4 \
+        --host-discovery-script ./discover.sh python jax_elastic_train.py
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hj
+from horovod_tpu.jax.elastic import JaxState, run
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    true_w = np.array([2.0, -1.0, 0.5, 1.0], np.float32)
+    rng = np.random.RandomState(hvd.rank())
+    X = rng.randn(256, 4).astype(np.float32)
+    Y = X @ true_w
+
+    params = {"w": jnp.zeros(4)}
+    tx = optax.sgd(args.lr)
+    opt_state = tx.init(params)
+    state = JaxState(params=params, opt_state=opt_state, epoch=0)
+
+    def lr_rescale():
+        print(f"[rank {hvd.rank()}] world resized to {hvd.size()}")
+
+    state.register_reset_callbacks([lr_rescale])
+
+    @run
+    def train(state):
+        tx_local = optax.sgd(args.lr)
+        while state.epoch < args.epochs:
+            def loss_fn(p):
+                return jnp.mean((jnp.asarray(X) @ p["w"] -
+                                 jnp.asarray(Y)) ** 2)
+
+            import jax
+            grads = jax.grad(loss_fn)(state.params)
+            grads = hj.allreduce_gradients(
+                grads, name_prefix=f"g{state.epoch}")
+            updates, state.opt_state = tx_local.update(
+                grads, state.opt_state, state.params)
+            state.params = optax.apply_updates(state.params, updates)
+            state.epoch += 1
+            state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch} size={hvd.size()} "
+                      f"w={np.asarray(state.params['w']).round(3)}")
+        return state.params
+
+    final = train(state)
+    if hvd.rank() == 0:
+        print("final w:", np.asarray(final["w"]).round(3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Keras MNIST with horovod_tpu (reference: examples/keras/keras_mnist.py
+— the BASELINE.md CPU/Gloo baseline config, adapted to Keras 3).
+
+Run:  horovodrun -np 2 -H localhost:2 python keras_mnist.py --epochs 1
+"""
+
+import argparse
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--synthetic", action="store_true",
+                        help="Use random data instead of downloading "
+                             "MNIST.")
+    args = parser.parse_args()
+
+    hvd.init()
+
+    if args.synthetic:
+        x_train = np.random.rand(4096, 28, 28, 1).astype("float32")
+        y_train = np.random.randint(0, 10, 4096)
+    else:
+        (x_train, y_train), _ = keras.datasets.mnist.load_data()
+        x_train = (x_train / 255.0).astype("float32")[..., None]
+
+    # Shard the dataset by rank (each worker sees 1/size of the data).
+    x_train = x_train[hvd.rank()::hvd.size()]
+    y_train = y_train[hvd.rank()::hvd.size()]
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # Scale the learning rate by world size (Goyal et al. linear
+    # scaling), wrap the optimizer, broadcast initial state.
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.Adam(args.lr * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], run_eagerly=True)
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=args.lr * hvd.size(), warmup_epochs=1,
+            steps_per_epoch=len(x_train) // args.batch_size or 1),
+    ]
+    model.fit(x_train, y_train, batch_size=args.batch_size,
+              epochs=args.epochs, callbacks=callbacks,
+              verbose=1 if hvd.rank() == 0 else 0)
+    if hvd.rank() == 0:
+        model.save("mnist_model.keras")
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

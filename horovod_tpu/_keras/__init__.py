@@ -63,11 +63,16 @@ def create_distributed_optimizer(optimizer, name=None,
                                  process_set=global_process_set,
                                  make_allreduce_grads_fn=None):
     if make_allreduce_grads_fn is None:
-        try:
-            from ..tensorflow import _make_allreduce_grads_fn as _fn
-            make_allreduce_grads_fn = _fn
-        except ImportError:
-            make_allreduce_grads_fn = None
+        # Pick by the ACTIVE Keras backend, not TF importability: with
+        # KERAS_BACKEND=jax the trainer feeds JAX arrays, which must
+        # not route through tf.py_function.
+        import keras
+        if keras.backend.backend() == "tensorflow":
+            try:
+                from ..tensorflow import _make_allreduce_grads_fn as _fn
+                make_allreduce_grads_fn = _fn
+            except ImportError:
+                make_allreduce_grads_fn = None
     if make_allreduce_grads_fn is not None:
         allreduce_grads = make_allreduce_grads_fn(
             name or "DistributedOptimizer", "", "", compression,

@@ -90,6 +90,9 @@ class CoordinatorServer:
         if param_manager is not None:
             param_manager.fusion_threshold_bytes = fusion_threshold
         self._table = MessageTable()
+        self._seen = 0
+        self._departed = 0
+        self._departed_cond = threading.Condition()
         # tensor name -> element count, for fusion byte accounting
         self._elem_cache: Dict[str, int] = {}
         self._joined: Set[int] = set()
@@ -149,6 +152,9 @@ class CoordinatorServer:
             rank = struct.unpack("<i", frame[1])[0]
             with self._lock:
                 self._conns[rank] = conn
+            with self._departed_cond:
+                self._seen += 1
+                self._departed_cond.notify_all()
             t = threading.Thread(target=self._rank_loop, args=(rank, conn),
                                  name=f"hvd-coord-rank{rank}", daemon=True)
             t.start()
@@ -171,8 +177,16 @@ class CoordinatorServer:
                     return
                 self._handle_requests(rank, requests)
         finally:
+            with self._departed_cond:
+                self._departed += 1
+                self._departed_cond.notify_all()
             if not self._stop.is_set():
                 self._on_rank_lost(rank, clean)
+
+    def departure_counts(self):
+        """(ever_connected, departed) rank-connection counters."""
+        with self._departed_cond:
+            return self._seen, self._departed
 
     def _on_rank_lost(self, rank: int, clean: bool):
         """A rank departed mid-run.  In elastic mode, pending
@@ -353,14 +367,7 @@ class NetworkController(Controller):
                     initial_cycle_ms=state.knobs.cycle_time_ms,
                     log_path=state.knobs.autotune_log)
                 state.parameter_manager = param_manager
-            self.server = CoordinatorServer(
-                self.size, port=port,
-                fusion_threshold=state.knobs.fusion_threshold_bytes,
-                timeline=state.timeline,
-                elastic=state.knobs.elastic,
-                allow_ephemeral_fallback=(
-                    self._rendezvous_client() is not None),
-                param_manager=param_manager)
+            self.server = self._make_server(state, port, param_manager)
             self._publish_actual_addr(addr, self.server.port)
             host = "127.0.0.1"
             self._addr = (host, self.server.port)
@@ -378,6 +385,36 @@ class NetworkController(Controller):
             target=self._recv_loop, name="hvd-ctrl-recv", daemon=True)
         self._recv_thread.start()
         self._send_lock = threading.Lock()
+
+    def _make_server(self, state, port, param_manager):
+        """Prefer the native C++ coordinator (horovod_tpu/native); fall
+        back to the Python CoordinatorServer.  The Python server is
+        also used when a timeline is active (negotiation spans are
+        recorded coordinator-side)."""
+        allow_ephemeral = self._rendezvous_client() is not None
+        if state.timeline is None:
+            try:
+                from ..native import NativeCoordinatorServer, available
+                if available():
+                    return NativeCoordinatorServer(
+                        self.size, port=port,
+                        fusion_threshold=(
+                            state.knobs.fusion_threshold_bytes),
+                        elastic=state.knobs.elastic,
+                        allow_ephemeral_fallback=allow_ephemeral,
+                        param_manager=param_manager)
+            except OSError:
+                raise   # bind failure: same semantics as Python server
+            except Exception:
+                logger.warning("native coordinator unavailable; using "
+                               "the Python coordinator", exc_info=True)
+        return CoordinatorServer(
+            self.size, port=port,
+            fusion_threshold=state.knobs.fusion_threshold_bytes,
+            timeline=state.timeline,
+            elastic=state.knobs.elastic,
+            allow_ephemeral_fallback=allow_ephemeral,
+            param_manager=param_manager)
 
     @staticmethod
     def _rendezvous_client():
@@ -496,4 +533,39 @@ class NetworkController(Controller):
         except OSError:
             pass
         if self.server is not None:
+            self._drain_server()
             self.server.stop()
+
+    # Grace window: if the set of ever-connected ranks is stagnant and
+    # all of them departed, remaining ranks crashed before connecting —
+    # no point waiting out the full timeout.
+    _DRAIN_STAGNATION_S = 5.0
+
+    def _drain_server(self):
+        """Keep serving until every rank departed, so ranks still
+        initializing (or draining) can reach the coordinator (the
+        reference's background thread likewise serves until all ranks
+        shut down, operations.cc:539-585).  Elastic resets use a short
+        cap: peers fail over via the broken-membership path anyway."""
+        timeout = 5.0 if self.state.knobs.elastic else \
+            float(os.environ.get("HOROVOD_START_TIMEOUT", 120))
+        deadline = time.monotonic() + timeout
+        prev_seen = -1
+        stagnant_since = time.monotonic()
+        while time.monotonic() < deadline:
+            seen, departed = self.server.departure_counts()
+            if departed >= self.size:
+                return
+            now = time.monotonic()
+            if seen != prev_seen:
+                prev_seen = seen
+                stagnant_since = now
+            elif departed >= seen and \
+                    now - stagnant_since > self._DRAIN_STAGNATION_S:
+                logger.warning(
+                    "stopping coordinator: %d/%d ranks never "
+                    "connected", self.size - seen, self.size)
+                return
+            time.sleep(0.1)
+        logger.warning("stopping coordinator with ranks still attached "
+                       "(waited %.0fs)", timeout)

@@ -68,13 +68,14 @@ class BayesianOptimization:
         u0 = cands[int(np.argmax(ei))]
         try:
             from scipy.optimize import minimize
+        except ImportError:
+            minimize = None
+        if minimize is not None:
             res = minimize(
-                lambda u: -float(self.expected_improvement(u[None, :])),
+                lambda u: -self.expected_improvement(u[None, :])[0],
                 u0, bounds=[(0.0, 1.0)] * self.dim, method="L-BFGS-B")
             if res.success:
                 u0 = res.x
-        except Exception:
-            pass
         return self._from_unit(np.clip(u0, 0.0, 1.0))
 
     @property

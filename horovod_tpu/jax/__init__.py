@@ -219,3 +219,6 @@ def metric_average(value, name: str,
 
 from . import elastic  # noqa: E402  (elastic needs the names above)
 __all__.append("elastic")
+
+from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: E402
+__all__ += ["SyncBatchNorm", "sync_batch_stats"]

@@ -1,0 +1,23 @@
+"""MXNet binding placeholder.
+
+The reference ships an MXNet binding (reference: horovod/mxnet/ —
+DistributedOptimizer, gluon DistributedTrainer, broadcast_parameters).
+MXNet reached end-of-life upstream (attic'd by Apache in 2023) and is
+not installed in TPU images; this module keeps the import surface with
+an actionable error instead of silently missing.
+"""
+
+_MSG = ("horovod_tpu.mxnet requires the 'mxnet' package, which is not "
+        "installed (MXNet is end-of-life upstream). Use the JAX "
+        "(horovod_tpu.jax), PyTorch (horovod_tpu.torch) or Keras "
+        "(horovod_tpu.keras) bindings instead.")
+
+try:
+    import mxnet  # noqa: F401
+    _HAS_MXNET = True
+except ImportError:
+    _HAS_MXNET = False
+
+if not _HAS_MXNET:
+    def __getattr__(name):
+        raise ImportError(_MSG)

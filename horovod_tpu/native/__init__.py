@@ -1,0 +1,173 @@
+"""Native control-plane core: ctypes loader and build for the C++
+coordinator (the analog of the reference's compiled C++ core that
+``HorovodBasics`` loads, reference: common/basics.py:22-30 — here the
+binding is ctypes over a plain C API instead of per-framework extension
+modules).
+
+The library builds lazily with g++ on first use (a few seconds, cached
+by source mtime under ``native/build/``); when no toolchain is
+available everything falls back to the pure-Python implementations.
+Set ``HOROVOD_TPU_NATIVE=0`` to force the Python paths.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger("horovod_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "coordinator.cc")
+_BUILD_DIR = os.path.join(_DIR, "build")
+_LIB = os.path.join(_BUILD_DIR, "libhvdtpu_coord.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("HOROVOD_TPU_NATIVE", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def ensure_built() -> bool:
+    """Compile the shared library if missing/stale; returns success."""
+    if not os.path.exists(_SRC):
+        return False
+    if os.path.exists(_LIB) and \
+            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _LIB + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_LIB + ".tmp", _LIB)
+        logger.info("built native coordinator: %s", _LIB)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        err = getattr(e, "stderr", b"")
+        logger.warning("native coordinator build failed (%s); using the "
+                       "Python coordinator", (err or b"")[:500])
+        return False
+
+
+def load():
+    """Returns the loaded CDLL or None."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _env_enabled():
+            return None
+        if not ensure_built():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            logger.warning("could not load %s", _LIB, exc_info=True)
+            return None
+        lib.hvd_coord_create.restype = ctypes.c_void_p
+        lib.hvd_coord_create.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_int]
+        lib.hvd_coord_port.restype = ctypes.c_int
+        lib.hvd_coord_port.argtypes = [ctypes.c_void_p]
+        lib.hvd_coord_set_fusion.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_longlong]
+        lib.hvd_coord_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_coord_stop.argtypes = [ctypes.c_void_p]
+        lib.hvd_coord_counts.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeCoordinatorServer:
+    """Drop-in replacement for controller_net.CoordinatorServer backed
+    by the C++ library.  When an autotuning ParameterManager is given, a
+    poll thread feeds it the coordinator's live round/byte counters and
+    pushes retuned fusion thresholds back."""
+
+    POLL_INTERVAL_S = 0.1
+
+    def __init__(self, size: int, bind_addr: str = "0.0.0.0",
+                 port: int = 0, fusion_threshold: int = 64 << 20,
+                 elastic: bool = False,
+                 allow_ephemeral_fallback: bool = False,
+                 param_manager=None):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native coordinator unavailable")
+        self._lib = lib
+        self._handle = lib.hvd_coord_create(
+            size, bind_addr.encode(), port, fusion_threshold,
+            1 if elastic else 0, 1 if allow_ephemeral_fallback else 0)
+        if not self._handle:
+            raise OSError(
+                f"native coordinator could not bind port {port}")
+        self.port = lib.hvd_coord_port(self._handle)
+        self.param_manager = param_manager
+        self._stop = threading.Event()
+        self._poll_thread = None
+        if param_manager is not None:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="hvd-native-autotune",
+                daemon=True)
+            self._poll_thread.start()
+
+    def _poll_loop(self):
+        last_rounds, last_bytes = 0, 0
+        rounds = ctypes.c_longlong()
+        nbytes = ctypes.c_longlong()
+        while not self._stop.wait(self.POLL_INTERVAL_S):
+            if not self.param_manager.active:
+                return
+            self._lib.hvd_coord_stats(self._handle,
+                                      ctypes.byref(rounds),
+                                      ctypes.byref(nbytes))
+            dr = rounds.value - last_rounds
+            db = nbytes.value - last_bytes
+            last_rounds, last_bytes = rounds.value, nbytes.value
+            if dr <= 0:
+                continue
+            # Feed the window: dr negotiation rounds moved db bytes.
+            per_round = db // dr
+            for _ in range(dr):
+                self.param_manager.record_step(per_round)
+            self._lib.hvd_coord_set_fusion(
+                self._handle,
+                self.param_manager.fusion_threshold_bytes)
+
+    def departure_counts(self):
+        """(ever_connected, departed) rank-connection counters."""
+        if not self._handle:
+            return 0, 0
+        seen = ctypes.c_int()
+        departed = ctypes.c_int()
+        self._lib.hvd_coord_counts(self._handle, ctypes.byref(seen),
+                                   ctypes.byref(departed))
+        return seen.value, departed.value
+
+    def stop(self):
+        self._stop.set()
+        # Join the poll thread BEFORE freeing the C++ object: a poll
+        # mid-flight would read freed memory.
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=2.0)
+            self._poll_thread = None
+        if self._handle:
+            self._lib.hvd_coord_stop(self._handle)
+            self._handle = None

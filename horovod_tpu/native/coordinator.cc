@@ -1,0 +1,738 @@
+// Native rank-0 coordinator: TCP negotiation server.
+//
+// The C++ equivalent of the reference's C++ controller/background core
+// (reference: common/controller.cc ComputeResponseList/:471-748
+// ConstructResponse/:777-914 FuseResponses + the transport loops of
+// mpi_controller.cc / gloo_controller.cc), rebuilt for the TPU
+// framework's event-driven TCP protocol.  Speaks the exact wire format
+// of horovod_tpu/common/message.py, so Python workers connect to it
+// unchanged; the Python CoordinatorServer remains as a fallback when
+// the shared library is unavailable.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -pthread coordinator.cc
+//            -o libhvdtpu_coord.so
+//
+// C API (ctypes):
+//   void* hvd_coord_create(int size, const char* bind_addr, int port,
+//                          long long fusion_threshold, int elastic,
+//                          int allow_ephemeral);     // NULL on failure
+//   int   hvd_coord_port(void*);
+//   void  hvd_coord_set_fusion(void*, long long);
+//   void  hvd_coord_stats(void*, long long* rounds, long long* bytes);
+//   void  hvd_coord_stop(void*);
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// wire protocol (mirrors message.py exactly; little-endian, packed)
+// ---------------------------------------------------------------------
+enum ReqType : int32_t {
+  REQ_ALLREDUCE = 0, REQ_ALLGATHER = 1, REQ_BROADCAST = 2, REQ_JOIN = 3,
+  REQ_ADASUM = 4, REQ_ALLTOALL = 5, REQ_REDUCESCATTER = 6,
+  REQ_BARRIER = 7,
+};
+enum RespType : int32_t {
+  RESP_ALLREDUCE = 0, RESP_ALLGATHER = 1, RESP_BROADCAST = 2,
+  RESP_JOIN = 3, RESP_ADASUM = 4, RESP_ALLTOALL = 5,
+  RESP_REDUCESCATTER = 6, RESP_BARRIER = 7, RESP_ERROR = 8,
+};
+
+const int kDtypeSize[] = {1, 1, 2, 2, 4, 8, 2, 4, 8, 1, 2};
+
+struct Request {
+  int32_t rank = 0;
+  int32_t type = 0;
+  int32_t dtype = 7;
+  int32_t root = -1;
+  int32_t device = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t psid = 0;
+  std::vector<int64_t> shape;
+  std::string name;
+  std::string op;
+  std::vector<int32_t> psr;  // process-set member ranks
+};
+
+struct Response {
+  int32_t type = 0;
+  int32_t dtype = 7;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t psid = 0;
+  int32_t root = -1;
+  int32_t last_joined = -1;
+  std::vector<std::string> names;
+  std::vector<int64_t> sizes;
+  std::string error;
+  std::string op = "Sum";
+  std::vector<std::vector<int64_t>> shapes;
+  std::vector<int32_t> psr;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* d, size_t n) : d_(d), n_(n) {}
+  template <typename T> T get() {
+    T v;
+    std::memcpy(&v, d_ + off_, sizeof(T));
+    off_ += sizeof(T);
+    return v;
+  }
+  std::string str(size_t len) {
+    std::string s(reinterpret_cast<const char*>(d_ + off_), len);
+    off_ += len;
+    return s;
+  }
+  bool ok(size_t need) const { return off_ + need <= n_; }
+  size_t off() const { return off_; }
+
+ private:
+  const uint8_t* d_;
+  size_t n_;
+  size_t off_ = 0;
+};
+
+class Writer {
+ public:
+  template <typename T> void put(T v) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+    buf_.insert(buf_.end(), p, p + sizeof(T));
+  }
+  void str(const std::string& s) {
+    buf_.insert(buf_.end(), s.begin(), s.end());
+  }
+  std::vector<uint8_t>& data() { return buf_; }
+
+ private:
+  std::vector<uint8_t> buf_;
+};
+
+bool parse_request(const uint8_t* d, size_t n, Request* r) {
+  // head "<iiiiiddiiHHH" = 50 bytes
+  if (n < 50) return false;
+  Reader rd(d, n);
+  r->rank = rd.get<int32_t>();
+  r->type = rd.get<int32_t>();
+  r->dtype = rd.get<int32_t>();
+  r->root = rd.get<int32_t>();
+  r->device = rd.get<int32_t>();
+  r->prescale = rd.get<double>();
+  r->postscale = rd.get<double>();
+  r->psid = rd.get<int32_t>();
+  int32_t ndim = rd.get<int32_t>();
+  uint16_t name_len = rd.get<uint16_t>();
+  uint16_t op_len = rd.get<uint16_t>();
+  uint16_t n_psr = rd.get<uint16_t>();
+  if (!rd.ok(size_t(ndim) * 8 + name_len + op_len + size_t(n_psr) * 4))
+    return false;
+  r->shape.resize(ndim);
+  for (int i = 0; i < ndim; ++i) r->shape[i] = rd.get<int64_t>();
+  r->name = rd.str(name_len);
+  r->op = rd.str(op_len);
+  r->psr.resize(n_psr);
+  for (int i = 0; i < n_psr; ++i) r->psr[i] = rd.get<int32_t>();
+  return true;
+}
+
+std::vector<uint8_t> serialize_response(const Response& r) {
+  Writer w;
+  w.put<int32_t>(r.type);
+  w.put<int32_t>(r.dtype);
+  w.put<double>(r.prescale);
+  w.put<double>(r.postscale);
+  w.put<int32_t>(r.psid);
+  w.put<int32_t>(r.root);
+  w.put<int32_t>(r.last_joined);
+  w.put<uint16_t>(uint16_t(r.names.size()));
+  w.put<uint16_t>(uint16_t(r.sizes.size()));
+  w.put<uint16_t>(uint16_t(r.error.size()));
+  w.put<uint16_t>(uint16_t(r.op.size()));
+  w.put<uint16_t>(uint16_t(r.shapes.size()));
+  w.put<uint16_t>(uint16_t(r.psr.size()));
+  for (const auto& n : r.names) {
+    w.put<uint16_t>(uint16_t(n.size()));
+    w.str(n);
+  }
+  for (int64_t s : r.sizes) w.put<int64_t>(s);
+  w.str(r.error);
+  w.str(r.op);
+  for (const auto& sh : r.shapes) {
+    w.put<uint16_t>(uint16_t(sh.size()));
+    for (int64_t d : sh) w.put<int64_t>(d);
+  }
+  for (int32_t p : r.psr) w.put<int32_t>(p);
+  return std::move(w.data());
+}
+
+std::vector<uint8_t> pack_response_list(const std::vector<Response>& rs) {
+  Writer w;
+  w.put<uint8_t>(0);  // shutdown flag
+  w.put<uint32_t>(uint32_t(rs.size()));
+  for (const auto& r : rs) {
+    auto b = serialize_response(r);
+    w.put<uint32_t>(uint32_t(b.size()));
+    w.data().insert(w.data().end(), b.begin(), b.end());
+  }
+  return std::move(w.data());
+}
+
+// ---------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------
+bool send_all(int fd, const uint8_t* d, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::send(fd, d + off, n - off, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    off += size_t(k);
+  }
+  return true;
+}
+
+bool send_frame(int fd, const char magic[2],
+                const std::vector<uint8_t>& payload) {
+  uint8_t head[6];
+  head[0] = magic[0];
+  head[1] = magic[1];
+  uint32_t len = uint32_t(payload.size());
+  std::memcpy(head + 2, &len, 4);
+  if (!send_all(fd, head, 6)) return false;
+  return send_all(fd, payload.data(), payload.size());
+}
+
+bool recv_exact(int fd, uint8_t* d, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::recv(fd, d + off, n - off, 0);
+    if (k <= 0) return false;
+    off += size_t(k);
+  }
+  return true;
+}
+
+bool recv_frame(int fd, std::vector<uint8_t>* payload) {
+  uint8_t head[6];
+  if (!recv_exact(fd, head, 6)) return false;
+  uint32_t len;
+  std::memcpy(&len, head + 2, 4);
+  if (len > (256u << 20)) return false;  // sanity bound
+  payload->resize(len);
+  return len == 0 || recv_exact(fd, payload->data(), len);
+}
+
+// ---------------------------------------------------------------------
+// negotiation logic (mirrors controller.py + controller_net.py)
+// ---------------------------------------------------------------------
+const std::set<int32_t> kFusable = {RESP_ALLREDUCE, RESP_ADASUM,
+                                    RESP_ALLGATHER, RESP_REDUCESCATTER};
+
+Response construct_response(const std::string& name,
+                            const std::vector<Request>& msgs, int size) {
+  const Request& first = msgs[0];
+  std::string err;
+  for (size_t i = 1; i < msgs.size() && err.empty(); ++i) {
+    const Request& m = msgs[i];
+    if (m.type != first.type)
+      err = "Mismatched collective operations for tensor " + name + ".";
+    else if (m.dtype != first.dtype)
+      err = "Mismatched data types for tensor " + name + ".";
+    else if (m.op != first.op)
+      err = "Mismatched reduction ops for tensor " + name + ".";
+    else if (m.prescale != first.prescale ||
+             m.postscale != first.postscale)
+      err = "Mismatched prescale/postscale factors for tensor " + name +
+            ".";
+    else if (first.type == REQ_BROADCAST && m.root != first.root)
+      err = "Mismatched broadcast root ranks for tensor " + name + ".";
+    else if ((first.type == REQ_ALLREDUCE || first.type == REQ_ADASUM ||
+              first.type == REQ_BROADCAST) &&
+             m.shape != first.shape)
+      err = "Mismatched shapes for tensor " + name + ".";
+    else if (first.type == REQ_ALLGATHER ||
+             first.type == REQ_ALLTOALL ||
+             first.type == REQ_REDUCESCATTER) {
+      if (m.shape.size() != first.shape.size() ||
+          (m.shape.size() > 1 &&
+           !std::equal(m.shape.begin() + 1, m.shape.end(),
+                       first.shape.begin() + 1)))
+        err = "Mismatched non-first dimensions for tensor " + name + ".";
+    }
+  }
+  if (!err.empty()) {
+    Response r;
+    r.type = RESP_ERROR;
+    r.names = {name};
+    r.error = err;
+    r.psid = first.psid;
+    return r;
+  }
+  Response r;
+  r.type = first.type;  // enum values align 1:1
+  r.names = {name};
+  r.dtype = first.dtype;
+  r.prescale = first.prescale;
+  r.postscale = first.postscale;
+  r.psid = first.psid;
+  r.root = first.root;
+  r.op = first.op;
+  r.shapes = {first.shape};
+  r.psr = first.psr;
+  if (first.type == REQ_ALLGATHER) {
+    std::map<int32_t, const Request*> by_rank;
+    for (const auto& m : msgs) by_rank[m.rank] = &m;
+    for (int rk = 0; rk < size; ++rk) {
+      auto it = by_rank.find(rk);
+      if (it != by_rank.end()) {
+        const auto& sh = it->second->shape;
+        r.sizes.push_back(sh.empty() ? 1 : sh[0]);
+      } else {
+        r.sizes.push_back(0);  // joined (departed) rank: zero rows
+      }
+    }
+  }
+  return r;
+}
+
+class Coordinator {
+ public:
+  Coordinator(int size, const std::string& bind_addr, int port,
+              int64_t fusion_threshold, bool elastic,
+              bool allow_ephemeral)
+      : size_(size),
+        fusion_threshold_(fusion_threshold),
+        elastic_(elastic) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(port));
+    addr.sin_addr.s_addr =
+        bind_addr.empty() ? INADDR_ANY : ::inet_addr(bind_addr.c_str());
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0) {
+      if (!allow_ephemeral) {
+        ::close(listen_fd_);
+        listen_fd_ = -1;
+        return;
+      }
+      addr.sin_port = 0;
+      if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+                 sizeof(addr)) != 0) {
+        ::close(listen_fd_);
+        listen_fd_ = -1;
+        return;
+      }
+    }
+    ::listen(listen_fd_, size + 4);
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&bound), &blen);
+    port_ = ntohs(bound.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  bool valid() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void set_fusion(int64_t v) { fusion_threshold_.store(v); }
+
+  void stats(int64_t* rounds, int64_t* bytes) {
+    *rounds = rounds_.load();
+    *bytes = bytes_.load();
+  }
+
+  void Stop() {
+    if (stop_.exchange(true)) return;  // idempotent (also ~Coordinator)
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& kv : conns_) {
+        ::shutdown(kv.second, SHUT_RDWR);
+        ::close(kv.second);
+      }
+      conns_.clear();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : rank_threads_)
+      if (t.joinable()) t.join();
+  }
+
+  ~Coordinator() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      pollfd pfd{listen_fd_, POLLIN, 0};
+      int rc = ::poll(&pfd, 1, 500);
+      if (stop_.load()) return;
+      if (rc <= 0) continue;
+      int conn = ::accept(listen_fd_, nullptr, nullptr);
+      if (conn < 0) continue;
+      int one = 1;
+      ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // First frame: rank id.
+      std::vector<uint8_t> payload;
+      if (!recv_frame(conn, &payload) || payload.size() < 4) {
+        ::close(conn);
+        continue;
+      }
+      int32_t rank;
+      std::memcpy(&rank, payload.data(), 4);
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        conns_[rank] = conn;
+      }
+      {
+        std::lock_guard<std::mutex> g(departed_mu_);
+        ++seen_;
+      }
+      rank_threads_.emplace_back(
+          [this, rank, conn] { RankLoop(rank, conn); });
+    }
+  }
+
+  void RankLoop(int rank, int conn) {
+    bool clean = false;
+    std::vector<uint8_t> payload;
+    while (!stop_.load()) {
+      if (!recv_frame(conn, &payload)) break;
+      if (payload.size() < 5) break;
+      uint8_t shutdown_flag = payload[0];
+      if (shutdown_flag) {
+        clean = true;
+        break;
+      }
+      uint32_t count;
+      std::memcpy(&count, payload.data() + 1, 4);
+      std::vector<Request> reqs;
+      size_t off = 5;
+      bool ok = true;
+      for (uint32_t i = 0; i < count && ok; ++i) {
+        if (off + 4 > payload.size()) {
+          ok = false;
+          break;
+        }
+        uint32_t len;
+        std::memcpy(&len, payload.data() + off, 4);
+        off += 4;
+        if (off + len > payload.size()) {
+          ok = false;
+          break;
+        }
+        Request r;
+        if (!parse_request(payload.data() + off, len, &r)) {
+          ok = false;
+          break;
+        }
+        off += len;
+        reqs.push_back(std::move(r));
+      }
+      if (!ok) break;
+      HandleRequests(rank, reqs);
+    }
+    {
+      std::lock_guard<std::mutex> g(departed_mu_);
+      ++departed_;
+      departed_cv_.notify_all();
+    }
+    if (!stop_.load()) OnRankLost(rank, clean);
+  }
+
+ public:
+  void DepartureCounts(int* seen, int* departed) {
+    std::lock_guard<std::mutex> g(departed_mu_);
+    *seen = seen_;
+    *departed = departed_;
+  }
+
+ private:
+
+  int RequiredFor(const Request& r) const {
+    return r.psr.empty() ? size_ : int(r.psr.size());
+  }
+
+  int JoinedCountFor(const Request& r) const {
+    if (r.psr.empty()) return int(joined_.size());
+    int c = 0;
+    for (int32_t p : r.psr)
+      if (joined_.count(p)) ++c;
+    return c;
+  }
+
+  // Tensors waiting only on joined (departed) ranks became complete.
+  void ScanComplete(std::vector<Response>* ready) {
+    std::vector<std::string> done;
+    for (auto& kv : table_) {
+      if (kv.second.empty()) continue;
+      const Request& first = kv.second[0];
+      int required = RequiredFor(first);
+      if (int(kv.second.size()) + JoinedCountFor(first) >= required) {
+        ready->push_back(
+            construct_response(kv.first, kv.second, size_));
+        done.push_back(kv.first);
+      }
+    }
+    for (const auto& n : done) table_.erase(n);
+  }
+
+  int64_t ResponseBytes(const Response& r) {
+    int64_t total = 0;
+    for (const auto& n : r.names) {
+      auto it = elem_cache_.find(n);
+      int64_t elems = it == elem_cache_.end() ? 0 : it->second;
+      total += elems * kDtypeSize[r.dtype];
+    }
+    return total;
+  }
+
+  bool CanFuse(const Response& a, const Response& b) {
+    if (a.type != b.type) return false;
+    if (!kFusable.count(a.type)) return false;
+    return a.dtype == b.dtype && a.psid == b.psid &&
+           a.prescale == b.prescale && a.postscale == b.postscale &&
+           a.op == b.op;
+  }
+
+  // Greedy fusion with look-ahead skip (fusion.py / reference
+  // controller.cc:777-914).
+  std::vector<Response> Fuse(std::vector<Response> queue) {
+    std::vector<Response> out;
+    int64_t threshold = fusion_threshold_.load();
+    while (!queue.empty()) {
+      Response base = std::move(queue.front());
+      queue.erase(queue.begin());
+      if (!kFusable.count(base.type)) {
+        out.push_back(std::move(base));
+        continue;
+      }
+      int64_t acc = ResponseBytes(base);
+      size_t i = 0;
+      while (i < queue.size()) {
+        Response& cand = queue[i];
+        if (CanFuse(base, cand)) {
+          int64_t cb = ResponseBytes(cand);
+          if (acc + cb <= threshold) {
+            base.names.insert(base.names.end(), cand.names.begin(),
+                              cand.names.end());
+            base.sizes.insert(base.sizes.end(), cand.sizes.begin(),
+                              cand.sizes.end());
+            base.shapes.insert(base.shapes.end(), cand.shapes.begin(),
+                               cand.shapes.end());
+            acc += cb;
+            queue.erase(queue.begin() + i);
+            continue;
+          }
+          break;  // full; keep remaining order intact
+        }
+        ++i;  // look-ahead skip
+      }
+      out.push_back(std::move(base));
+    }
+    return out;
+  }
+
+  void BroadcastLocked(const std::vector<Response>& responses) {
+    auto payload = pack_response_list(responses);
+    std::vector<int> dead;
+    for (auto& kv : conns_) {
+      if (!send_frame(kv.second, "RS", payload)) dead.push_back(kv.first);
+    }
+    for (int r : dead) {
+      ::close(conns_[r]);
+      conns_.erase(r);
+    }
+  }
+
+  void HandleRequests(int rank, const std::vector<Request>& reqs) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (broken_) {
+      std::vector<Response> errs;
+      for (const auto& req : reqs) {
+        Response r;
+        r.type = RESP_ERROR;
+        r.names = {req.name};
+        r.error = "membership changed; collective cannot complete";
+        errs.push_back(std::move(r));
+      }
+      if (!errs.empty()) BroadcastLocked(errs);
+      return;
+    }
+    std::vector<Response> ready;
+    for (const auto& req : reqs) {
+      int64_t n = 1;
+      for (int64_t d : req.shape) n *= d;
+      elem_cache_[req.name] = n;
+      if (req.type == REQ_JOIN) {
+        joined_.insert(rank);
+        last_joined_ = rank;
+        if (int(joined_.size()) == size_) {
+          Response r;
+          r.type = RESP_JOIN;
+          r.names = {"join"};
+          r.last_joined = last_joined_;
+          ready.push_back(std::move(r));
+          joined_.clear();
+        } else {
+          ScanComplete(&ready);
+        }
+        continue;
+      }
+      if (req.type == REQ_BARRIER) {
+        int required = RequiredFor(req);
+        auto& arrived = barriers_[req.name];
+        arrived.insert(rank);
+        if (int(arrived.size()) >= required) {
+          barriers_.erase(req.name);
+          Response r;
+          r.type = RESP_BARRIER;
+          r.names = {req.name};
+          r.psid = req.psid;
+          r.psr = req.psr;
+          ready.push_back(std::move(r));
+        }
+        continue;
+      }
+      int required = RequiredFor(req);
+      auto& msgs = table_[req.name];
+      msgs.push_back(req);
+      if (int(msgs.size()) + JoinedCountFor(req) >= required) {
+        ready.push_back(construct_response(req.name, msgs, size_));
+        table_.erase(req.name);
+      }
+    }
+    if (ready.empty()) return;
+    auto fused = Fuse(std::move(ready));
+    BroadcastLocked(fused);
+    int64_t nbytes = 0;
+    for (const auto& r : fused) nbytes += ResponseBytes(r);
+    rounds_.fetch_add(1);
+    bytes_.fetch_add(nbytes);
+  }
+
+  void OnRankLost(int rank, bool clean) {
+    if (!elastic_) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = conns_.find(rank);
+    if (it != conns_.end()) {
+      ::close(it->second);
+      conns_.erase(it);
+    }
+    broken_ = true;
+    std::vector<Response> errs;
+    std::string msg = "rank " + std::to_string(rank) +
+                      " left the job (" +
+                      (clean ? "clean" : "connection lost") +
+                      "); membership changed";
+    for (auto& kv : table_) {
+      Response r;
+      r.type = RESP_ERROR;
+      r.names = {kv.first};
+      r.error = msg;
+      errs.push_back(std::move(r));
+    }
+    for (auto& kv : barriers_) {
+      Response r;
+      r.type = RESP_ERROR;
+      r.names = {kv.first};
+      r.error = msg;
+      errs.push_back(std::move(r));
+    }
+    table_.clear();
+    barriers_.clear();
+    if (!errs.empty()) BroadcastLocked(errs);
+  }
+
+  int size_;
+  std::atomic<int64_t> fusion_threshold_;
+  bool elastic_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> rank_threads_;
+
+  std::mutex mu_;
+  std::map<int, int> conns_;                      // rank -> fd
+  std::map<std::string, std::vector<Request>> table_;
+  std::map<std::string, std::set<int>> barriers_;
+  std::map<std::string, int64_t> elem_cache_;
+  std::set<int> joined_;
+  int last_joined_ = -1;
+  bool broken_ = false;
+  std::mutex departed_mu_;
+  std::condition_variable departed_cv_;
+  int seen_ = 0;
+  int departed_ = 0;
+  std::atomic<int64_t> rounds_{0};
+  std::atomic<int64_t> bytes_{0};
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvd_coord_create(int size, const char* bind_addr, int port,
+                       long long fusion_threshold, int elastic,
+                       int allow_ephemeral) {
+  auto* c = new Coordinator(size, bind_addr ? bind_addr : "", port,
+                            fusion_threshold, elastic != 0,
+                            allow_ephemeral != 0);
+  if (!c->valid()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int hvd_coord_port(void* h) {
+  return static_cast<Coordinator*>(h)->port();
+}
+
+void hvd_coord_set_fusion(void* h, long long v) {
+  static_cast<Coordinator*>(h)->set_fusion(v);
+}
+
+void hvd_coord_stats(void* h, long long* rounds, long long* bytes) {
+  int64_t r, b;
+  static_cast<Coordinator*>(h)->stats(&r, &b);
+  *rounds = r;
+  *bytes = b;
+}
+
+void hvd_coord_counts(void* h, int* seen, int* departed) {
+  static_cast<Coordinator*>(h)->DepartureCounts(seen, departed);
+}
+
+void hvd_coord_stop(void* h) {
+  auto* c = static_cast<Coordinator*>(h);
+  c->Stop();
+  delete c;
+}
+
+}  // extern "C"

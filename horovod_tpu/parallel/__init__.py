@@ -14,3 +14,7 @@ __all__ = [
     "alltoall", "ppermute", "neighbor_shift", "axis_index", "axis_size",
     "hierarchical_allreduce_sum",
 ]
+
+from .attention import (reference_attention, ring_attention,
+                        ulysses_attention)
+__all__ += ["ring_attention", "ulysses_attention", "reference_attention"]

@@ -1,0 +1,99 @@
+"""Spark orchestrator integration (reference: horovod/spark/ —
+``horovod.spark.run()`` launches one training task per executor over a
+barrier stage (spark/runner.py:417, task fn :31-80); the Estimator API
+and Store abstraction live in submodules).
+
+``run()`` needs a live ``pyspark`` session (imported lazily); the
+coordination pieces (env contract, rendezvous, store) are pure Python.
+"""
+
+import logging
+import os
+import socket
+from typing import Callable, List, Optional
+
+from ..runner.hosts import HostInfo, get_host_assignments, slot_env_vars
+from ..runner.http_server import RendezvousServer, find_ports, \
+    local_addresses
+from .store import FilesystemStore, Store
+
+logger = logging.getLogger("horovod_tpu.spark")
+
+__all__ = ["run", "Store", "FilesystemStore"]
+
+
+def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
+        extra_env: Optional[dict] = None, verbose: int = 2) -> List:
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark tasks in a
+    barrier stage; returns results ordered by rank (reference:
+    spark/runner.py:417 ``run``)."""
+    try:
+        import pyspark
+        from pyspark import BarrierTaskContext
+        from pyspark.sql import SparkSession
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark.run requires pyspark, which is not "
+            "installed in this environment.") from e
+    import cloudpickle
+
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    if num_proc is None:
+        num_proc = max(int(sc.defaultParallelism), 1)
+
+    server = RendezvousServer(verbose)
+    rendezvous_port = server.start()
+    server.init({})
+    driver_ip = local_addresses()[0]
+    payload = cloudpickle.dumps((fn, args, kwargs or {}))
+
+    def task_fn(index, _iterator):
+        ctx = BarrierTaskContext.get()
+        hostname = socket.gethostname()
+        # Exchange hostnames through the barrier to build the slot
+        # plan identically on every task (reference spark task fn).
+        infos = ctx.allGather(hostname)
+        counts = {}
+        ordered = []
+        for h in infos:
+            if h not in counts:
+                ordered.append(h)
+            counts[h] = counts.get(h, 0) + 1
+        hosts = [HostInfo(h, counts[h]) for h in ordered]
+        slots = get_host_assignments(hosts, len(infos), len(infos))
+        # This task's slot: the index-th occurrence of its hostname.
+        occurrence = sum(1 for h in infos[:index] if h == hostname)
+        my_slot = [s for s in slots if s.hostname == hostname][occurrence]
+
+        env = slot_env_vars(my_slot)
+        env.update({
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
+            "HOROVOD_CONTROLLER": "tcp",
+        })
+        # Rank 0 announces coordinator/controller endpoints through the
+        # barrier so all tasks agree.
+        if my_slot.rank == 0:
+            cport, ctlport = find_ports(2)
+            addr = socket.gethostbyname(hostname)
+            endpoints = f"{addr}:{cport},{addr}:{ctlport}"
+        else:
+            endpoints = ""
+        all_endpoints = [e for e in ctx.allGather(endpoints) if e]
+        coord, ctrl = all_endpoints[0].split(",")
+        env["HOROVOD_TPU_COORDINATOR"] = coord
+        env["HOROVOD_CONTROLLER_ADDR"] = ctrl
+        os.environ.update(env)
+
+        f, a, kw = cloudpickle.loads(payload)
+        result = f(*a, **kw)
+        return [(my_slot.rank, cloudpickle.dumps(result))]
+
+    try:
+        rdd = sc.parallelize(range(num_proc), num_proc).barrier()
+        collected = rdd.mapPartitionsWithIndex(task_fn).collect()
+        by_rank = dict(collected)
+        return [cloudpickle.loads(by_rank[r]) for r in range(num_proc)]
+    finally:
+        server.stop()

@@ -1,0 +1,103 @@
+"""Store abstraction: where estimators keep data, checkpoints and logs.
+
+Reference: spark/common/store.py:32-150 — ``Store`` defines train-data
+/ checkpoint / logs paths; ``FilesystemStore`` implements them on a
+local or network filesystem (HDFS/S3 subclasses layer protocol prefixes
+on the same structure; on GCP the natural target is GCS via fsspec).
+"""
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    def get_train_data_path(self, idx=None) -> str:
+        raise NotImplementedError()
+
+    def get_val_data_path(self, idx=None) -> str:
+        raise NotImplementedError()
+
+    def get_test_data_path(self, idx=None) -> str:
+        raise NotImplementedError()
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError()
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError()
+
+    def write(self, path: str, data: bytes):
+        raise NotImplementedError()
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        return FilesystemStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Plain-filesystem store (reference: spark/common/store.py
+    LocalStore/FilesystemStore semantics — fixed subdirectory layout
+    under a prefix path)."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 test_path: Optional[str] = None,
+                 runs_path: Optional[str] = None):
+        self.prefix_path = prefix_path
+        self._train = train_path or os.path.join(prefix_path,
+                                                 "intermediate_train_data")
+        self._val = val_path or os.path.join(prefix_path,
+                                             "intermediate_val_data")
+        self._test = test_path or os.path.join(prefix_path,
+                                               "intermediate_test_data")
+        self._runs = runs_path or os.path.join(prefix_path, "runs")
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def _idx(self, base: str, idx) -> str:
+        return base if idx is None else f"{base}.{idx}"
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._idx(self._train, idx)
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._idx(self._val, idx)
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._idx(self._test, idx)
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._runs, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def delete(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)

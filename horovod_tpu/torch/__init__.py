@@ -57,8 +57,11 @@ def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
 
 def _to_torch(arr, like: Optional[torch.Tensor] = None) -> torch.Tensor:
     t = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
-    if like is not None and t.dtype != like.dtype:
-        t = t.to(like.dtype)
+    if like is not None:
+        if t.dtype != like.dtype:
+            t = t.to(like.dtype)
+        if t.device != like.device:
+            t = t.to(like.device)   # restore the input's device
     return t
 
 
@@ -138,7 +141,7 @@ def allreduce(tensor, average=None, name=None, compression=Compression.none,
     if tensor.requires_grad:
         return _AllreduceFunction.apply(
             tensor, name, _resolve(op, average), prescale_factor,
-            postscale_factor, process_set)
+            postscale_factor, process_set, compression)
     return synchronize(allreduce_async(
         tensor, average=average, name=name, op=op,
         prescale_factor=prescale_factor,
@@ -151,20 +154,23 @@ class _AllreduceFunction(torch.autograd.Function):
     HorovodAllreduce autograd.Function)."""
 
     @staticmethod
-    def forward(ctx, tensor, name, op, prescale, postscale, process_set):
+    def forward(ctx, tensor, name, op, prescale, postscale, process_set,
+                compression):
         ctx.op = op
         ctx.prescale = prescale
         ctx.postscale = postscale
         ctx.process_set = process_set
+        ctx.compression = compression
         h = _allreduce_async_np(tensor, name, op, prescale, postscale,
-                                process_set)
+                                process_set, compression)
         return h.wait()
 
     @staticmethod
     def backward(ctx, grad_output):
         h = _allreduce_async_np(grad_output, None, ctx.op, ctx.prescale,
-                                ctx.postscale, ctx.process_set)
-        return h.wait(), None, None, None, None, None
+                                ctx.postscale, ctx.process_set,
+                                ctx.compression)
+        return h.wait(), None, None, None, None, None, None
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None,

@@ -38,9 +38,11 @@ class _SyncBatchNormFn(torch.autograd.Function):
         num_features = input.shape[1]
         total = float(reduced[0])
         mean = torch.from_numpy(
-            reduced[1:1 + num_features] / total).to(input.dtype)
+            reduced[1:1 + num_features] / total).to(
+                dtype=input.dtype, device=input.device)
         sq_mean = torch.from_numpy(
-            reduced[1 + num_features:] / total).to(input.dtype)
+            reduced[1 + num_features:] / total).to(
+                dtype=input.dtype, device=input.device)
         var = (sq_mean - mean * mean).clamp_min_(0.0)
         invstd = torch.rsqrt(var + eps)
 
@@ -75,8 +77,10 @@ class _SyncBatchNormFn(torch.autograd.Function):
                                  f"sync_bn_bwd/{ctx.op_id}",
                                  ctx.process_set)
         n = grad_output.shape[1]
-        sum_dy = torch.from_numpy(reduced[:n]).to(grad_output.dtype)
-        sum_dy_xhat = torch.from_numpy(reduced[n:]).to(grad_output.dtype)
+        sum_dy = torch.from_numpy(reduced[:n]).to(
+            dtype=grad_output.dtype, device=grad_output.device)
+        sum_dy_xhat = torch.from_numpy(reduced[n:]).to(
+            dtype=grad_output.dtype, device=grad_output.device)
 
         grad_input = invstd.reshape(shape) * (
             grad_xhat - sum_dy.reshape(shape) / total -

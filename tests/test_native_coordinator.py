@@ -1,0 +1,60 @@
+"""Native C++ coordinator tests: the same 2-process collectives must
+work against both the native and Python coordinators (the workers pick
+the coordinator implementation at init; the wire protocol is shared).
+"""
+
+import pytest
+
+from multiproc import assert_all_ok, run_workers
+
+BODY = """
+names = []
+# allreduce with scales
+out = hvd.allreduce(np.ones(8, np.float32) * (RANK + 1), op=hvd.Sum,
+                    name="ar", prescale_factor=0.5)
+assert np.allclose(out, np.ones(8) * 1.5), out
+# grouped (fusable) + mixed dtypes exercise fusion look-ahead
+outs = hvd.grouped_allreduce(
+    [np.ones(4, np.float32), np.ones(2, np.float64) * 2], op=hvd.Sum,
+    name="g")
+assert np.allclose(outs[0], 2 * np.ones(4))
+assert np.allclose(outs[1], 4 * np.ones(2))
+# allgather of unequal first dims
+mine = np.arange((RANK + 1) * 2, dtype=np.int64).reshape(RANK + 1, 2)
+g = np.asarray(hvd.allgather(mine, name="ag"))
+assert g.shape == (3, 2), g.shape
+# broadcast
+b = np.asarray(hvd.broadcast(np.full(3, RANK, np.float32), 1,
+                             name="bc"))
+assert np.allclose(b, 1.0)
+# barrier + join
+hvd.barrier()
+last = hvd.join()
+assert last in (0, 1)
+# shape-mismatch must produce a coordinator error
+try:
+    hvd.allreduce(np.ones(3 + RANK, np.float32), op=hvd.Sum,
+                  name="bad")
+    raise SystemExit("expected coordinator error")
+except Exception as e:
+    assert "Mismatched" in str(e) or "mismatch" in str(e).lower(), e
+print("COORD OK", RANK)
+"""
+
+
+@pytest.mark.parametrize("native", ["1", "0"])
+def test_coordinator_protocol(native):
+    results = run_workers(BODY, nproc=2, extra_env={
+        "HOROVOD_TPU_NATIVE": native})
+    assert_all_ok(results)
+    for _, out in results:
+        assert "COORD OK" in out
+
+
+def test_native_lib_builds_and_binds():
+    from horovod_tpu.native import NativeCoordinatorServer, available
+    if not available():
+        pytest.skip("no native toolchain")
+    srv = NativeCoordinatorServer(2)
+    assert srv.port > 0
+    srv.stop()

@@ -1,0 +1,79 @@
+"""Orchestrator adapter tests with fakes — Ray/Spark are not installed
+in this environment (mirrors reference test/single/test_ray*.py using
+mocks for placement)."""
+
+import sys
+import types
+
+import pytest
+
+
+def test_ray_coordinator_env_contract():
+    from horovod_tpu.ray import Coordinator
+    c = Coordinator()
+    c.register("hostA", 0)
+    c.register("hostA", 1)
+    c.register("hostB", 2)
+    c.register("hostB", 3)
+    env = c.finalize_registration()
+    assert env[0]["HOROVOD_RANK"] == "0"
+    assert env[0]["HOROVOD_LOCAL_RANK"] == "0"
+    assert env[1]["HOROVOD_LOCAL_RANK"] == "1"
+    assert env[2]["HOROVOD_RANK"] == "2"
+    assert env[2]["HOROVOD_HOSTNAME"] == "hostB"
+    assert env[3]["HOROVOD_CROSS_RANK"] == "1"
+    assert all(v["HOROVOD_SIZE"] == "4" for v in env.values())
+
+
+def test_ray_host_discovery_with_fake_ray(monkeypatch):
+    fake_ray = types.ModuleType("ray")
+    fake_ray.nodes = lambda: [
+        {"Alive": True, "NodeManagerHostname": "n1",
+         "Resources": {"CPU": 4.0}},
+        {"Alive": True, "NodeManagerHostname": "n2",
+         "Resources": {"CPU": 2.0, "GPU": 1.0}},
+        {"Alive": False, "NodeManagerHostname": "dead",
+         "Resources": {"CPU": 8.0}},
+    ]
+    monkeypatch.setitem(sys.modules, "ray", fake_ray)
+    from horovod_tpu.ray import RayHostDiscovery
+    d = RayHostDiscovery(cpus_per_slot=2)
+    assert d.find_available_hosts_and_slots() == {"n1": 2, "n2": 1}
+    g = RayHostDiscovery(use_gpu=True)
+    assert g.find_available_hosts_and_slots() == {"n2": 1}
+
+
+def test_elastic_ray_executor_uses_discovery():
+    from horovod_tpu.ray import ElasticRayExecutor
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    ex = ElasticRayExecutor(min_np=2,
+                            override_discovery=FixedHosts({"x": 2}))
+    assert ex.discovery.find_available_hosts_and_slots() == {"x": 2}
+
+
+def test_spark_run_requires_pyspark():
+    from horovod_tpu import spark
+    with pytest.raises(ImportError, match="pyspark"):
+        spark.run(lambda: None)
+
+
+def test_filesystem_store(tmp_path):
+    from horovod_tpu.spark import FilesystemStore, Store
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, FilesystemStore)
+    ckpt = store.get_checkpoint_path("run1")
+    assert "run1" in ckpt
+    assert not store.exists(ckpt)
+    store.write(ckpt, b"weights")
+    assert store.exists(ckpt)
+    assert store.read(ckpt) == b"weights"
+    assert store.get_train_data_path(3).endswith(".3")
+    assert store.get_logs_path("run1") != ckpt
+    store.delete(store.get_run_path("run1"))
+    assert not store.exists(ckpt)
+
+
+def test_mxnet_stub_raises_actionably():
+    import horovod_tpu.mxnet as hm
+    with pytest.raises(ImportError, match="end-of-life"):
+        hm.DistributedOptimizer
