@@ -37,6 +37,11 @@ class BertConfig:
     # Use jax.checkpoint on each layer to trade FLOPs for HBM
     # (rematerialisation; essential for long sequence / large batch).
     remat: bool = False
+    # "einsum": plain XLA attention (supports padding masks, lets GSPMD
+    # shard freely).  "flash": the Pallas flash kernel
+    # (ops/pallas_attention.py) — O(S) memory, fused online softmax;
+    # padding masks are not yet supported by the kernel.
+    attention_impl: str = "einsum"
 
 
 def bert_large_config(**kw) -> BertConfig:
@@ -71,18 +76,31 @@ class SelfAttention(nn.Module):
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
-        # [batch, heads, q_len, k_len] — contraction and the subsequent
-        # PV matmul are the MXU hot loops.
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        scores = scores / math.sqrt(head_dim)
-        if mask is not None:
-            big_neg = jnp.finfo(cfg.dtype).min
-            scores = jnp.where(mask[:, None, None, :], scores, big_neg)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        probs = probs.astype(cfg.dtype)
-        probs = nn.Dropout(cfg.attention_dropout)(
-            probs, deterministic=deterministic)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.attention_impl == "flash":
+            if mask is not None:
+                raise NotImplementedError(
+                    "attention_impl='flash' does not support padding "
+                    "masks yet; use 'einsum' or drop the mask.")
+            if cfg.attention_dropout > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "attention_impl='flash' does not apply attention "
+                    "dropout; set attention_dropout=0 or use 'einsum'.")
+            from ..ops.pallas_attention import flash_attention
+            ctx = flash_attention(q, k, v).astype(cfg.dtype)
+        else:
+            # [batch, heads, q_len, k_len] — contraction and the
+            # subsequent PV matmul are the MXU hot loops.
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            scores = scores / math.sqrt(head_dim)
+            if mask is not None:
+                big_neg = jnp.finfo(cfg.dtype).min
+                scores = jnp.where(mask[:, None, None, :], scores,
+                                   big_neg)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            probs = probs.astype(cfg.dtype)
+            probs = nn.Dropout(cfg.attention_dropout)(
+                probs, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
                               dtype=cfg.dtype, param_dtype=jnp.float32,
                               name="out")(ctx)

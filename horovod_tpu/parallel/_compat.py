@@ -1,0 +1,20 @@
+"""JAX version-compat shims shared by the parallel modules."""
+
+from jax import lax
+
+
+def pvary(x, axis_names):
+    """Mark x as device-varying over the given axes (pcast on newer
+    JAX, pvary on older), skipping axes it already varies over."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    try:
+        current = set(getattr(x.aval, "vma", ()))
+    except Exception:
+        current = set()
+    missing = tuple(a for a in axis_names if a not in current)
+    if not missing:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, missing, to="varying")
+    return lax.pvary(x, missing)

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._compat import pvary as _pvary
+
 
 def _block_scores(q, k, scale):
     # q: [B, Sq, H, D], k: [B, Skv, H, D] -> [B, H, Sq, Skv] in f32
@@ -56,10 +58,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # pvary: mark the accumulators as device-varying over the axis so
     # the scan carry type matches its (q-dependent, hence varying)
     # updates under shard_map's varying-axis typing.
-    m = lax.pvary(jnp.full((B, H, Sq), -jnp.inf, dtype=jnp.float32),
+    m = _pvary(jnp.full((B, H, Sq), -jnp.inf, dtype=jnp.float32),
                   axis_name)
-    l = lax.pvary(jnp.zeros((B, H, Sq), dtype=jnp.float32), axis_name)
-    o = lax.pvary(jnp.zeros((B, Sq, H, D), dtype=jnp.float32),
+    l = _pvary(jnp.zeros((B, H, Sq), dtype=jnp.float32), axis_name)
+    o = _pvary(jnp.zeros((B, Sq, H, D), dtype=jnp.float32),
                   axis_name)
 
     q_pos = my_idx * Sq + jnp.arange(Sq)            # global q positions

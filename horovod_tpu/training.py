@@ -28,18 +28,21 @@ class TrainState(train_state.TrainState):
     pass
 
 
-def factor_mesh_axes(n_devices: int) -> Dict[str, int]:
-    """Factor a device count into (dp, tp, sp) sizes, preferring dp.
+def factor_mesh_axes(n_devices: int,
+                     names: Tuple[str, ...] = ("dp", "tp", "sp"),
+                     absorb: str = "dp") -> Dict[str, int]:
+    """Factor a device count into 2s over the named axes, in order.
 
-    8 → dp2·tp2·sp2, 4 → dp2·tp2, 2 → dp2, 1 → all-1 (degenerate).
+    8 → first three axes get 2; 4 → first two; 2 → first; any odd
+    remainder is absorbed into ``absorb``.
     """
-    axes = {"dp": 1, "tp": 1, "sp": 1}
+    axes = {name: 1 for name in names}
     rest = n_devices
-    for name in ("dp", "tp", "sp"):
+    for name in names:
         if rest % 2 == 0:
             axes[name] = 2
             rest //= 2
-    axes["dp"] *= rest  # absorb any remainder into dp
+    axes[absorb] *= rest
     return axes
 
 
@@ -126,6 +129,91 @@ def make_bert_batch(batch_size: int, seq_len: int, vocab_size: int,
                          dtype=np.int32)
     mask = (rng.rand(batch_size, seq_len) < 0.15).astype(np.int32)
     return {"input_ids": input_ids, "labels": labels, "mask": mask}
+
+
+def run_pipeline_moe_dry_run(n_devices: int, microbatches: int = 4,
+                             tokens: int = 8, dim: int = 16):
+    """One differentiable pipeline-parallel + expert-parallel training
+    step on a {pp, ep, dp} mesh with tiny shapes: each pipeline stage is
+    dense → Switch-MoE (alltoall over ep) → dense, microbatches stream
+    GPipe-style over pp, gradients reduce over dp."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from .parallel.mesh import build_mesh
+    from .parallel.moe import moe_ffn
+    from .parallel.pipeline import pipeline_apply
+
+    axes = factor_mesh_axes(n_devices, names=("pp", "ep", "dp"))
+    mesh = build_mesh(axes)
+    S, E = axes["pp"], axes["ep"]
+
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(S, dim, dim).astype(np.float32) * 0.2)
+    gate_w = jnp.asarray(rng.randn(S, dim, E).astype(np.float32))
+    expert_W = jnp.asarray(
+        rng.randn(S, E, dim, dim).astype(np.float32) * 0.2)
+    x = jnp.asarray(rng.randn(
+        microbatches, axes["dp"] * tokens, dim).astype(np.float32))
+
+    def expert_fn(W, h):
+        return jnp.tanh(h @ W[0])
+
+    def stage(params, h):
+        W, gw, eW = params
+        h = jnp.tanh(h @ W[0])
+        y, _aux = moe_ffn(h, gw[0], expert_fn, eW[0], axis_name="ep",
+                          capacity_factor=4.0)
+        return h + y
+
+    def loss_fn(Ws, gate_w, expert_W, xm):
+        out = pipeline_apply(stage, (Ws, gate_w, expert_W), xm,
+                             axis_name="pp", vary_axes=("ep", "dp"))
+        return jnp.mean(out ** 2)
+
+    def grads_fn(Ws, gate_w, expert_W, xm):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            Ws, gate_w, expert_W, xm)
+        # Gradient data parallelism over dp.
+        grads = jax.tree.map(
+            lambda g: jax.lax.pmean(g, "dp"), grads)
+        return jax.lax.pmean(loss, ("dp", "ep")), grads
+
+    run = jax.jit(jax.shard_map(
+        grads_fn, mesh=mesh,
+        in_specs=(P("pp"), P("pp"), P("pp", "ep"), P(None, "dp")),
+        out_specs=(P(), (P("pp"), P("pp"), P("pp", "ep")))))
+    loss, grads = run(Ws, gate_w, expert_W, x)
+    jax.block_until_ready(loss)
+    return float(loss), mesh
+
+
+def run_ring_attention_dry_run(n_devices: int, seq_per_dev: int = 8,
+                               heads: int = 4, dim: int = 8):
+    """Ring attention over an sp-axis mesh: one causal forward+backward
+    on a sequence sharded across every device."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from .parallel.attention import ring_attention
+    from .parallel.mesh import build_mesh
+
+    mesh = build_mesh({"sp": n_devices})
+    rng = np.random.RandomState(0)
+    S = n_devices * seq_per_dev
+    q, k, v = (jnp.asarray(rng.randn(1, S, heads, dim)
+                           .astype(np.float32)) for _ in range(3))
+
+    def loss(q, k, v):
+        return jnp.mean(
+            ring_attention(q, k, v, axis_name="sp", causal=True) ** 2)
+
+    f = jax.jit(jax.shard_map(
+        jax.grad(loss), mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp")))
+    g = f(q, k, v)
+    jax.block_until_ready(g)
+    assert not jnp.isnan(jnp.asarray(g)).any(), \
+        "ring attention produced NaN gradients"
+    return mesh
 
 
 def run_bert_dry_run(n_devices: int, config: Optional[BertConfig] = None,
