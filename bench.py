@@ -72,7 +72,12 @@ def main():
         loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
         return loss, updates["batch_stats"]
 
-    @jax.jit
+    from functools import partial
+
+    # Donation lets XLA update params/opt state in place (no HBM
+    # copies per step — the analog of the reference's fusion-buffer
+    # reuse, SURVEY §7 in-place semantics).
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, x, labels):
         (loss, new_bs), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch_stats, x, labels)
