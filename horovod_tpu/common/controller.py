@@ -172,7 +172,9 @@ class LoopbackController(Controller):
 
     def compute_response_list(self, pending, entry_sizes, threshold_bytes):
         responses: List[Response] = []
+        group_ids = {}
         for req in pending:
+            group_ids[req.tensor_name] = req.group_id
             if req.request_type == RequestType.JOIN:
                 self.joined_ranks.add(req.request_rank)
                 self.last_joined_rank = req.request_rank
@@ -184,5 +186,6 @@ class LoopbackController(Controller):
                 continue
             responses.append(construct_response(
                 req.tensor_name, [req], 1, self.joined_ranks))
-        fused = fuse_responses(responses, entry_sizes, threshold_bytes)
+        fused = fuse_responses(responses, entry_sizes, threshold_bytes,
+                               group_ids)
         return fused, []

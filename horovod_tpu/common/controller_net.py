@@ -31,15 +31,24 @@ from typing import Dict, List, Optional, Set, Tuple
 from .controller import Controller, MessageTable, construct_response
 from .fusion import fuse_responses
 from .message import (Request, RequestType, Response, ResponseType,
-                      dtype_size, pack_request_list, pack_response_list,
+                      dtype_size, pack_bit_batches, pack_bits,
+                      pack_request_list, pack_response_list,
+                      unpack_bit_batches, unpack_bits,
                       unpack_request_list, unpack_response_list)
+from .response_cache import (CACHEABLE, CoordinatorCache,
+                             WorkerResponseCache, merge_responses,
+                             request_signature, signature_to_request,
+                             split_response)
 
 logger = logging.getLogger("horovod_tpu.controller_net")
 
 CONTROLLER_ADDR_ENV = "HOROVOD_CONTROLLER_ADDR"
 
-_MAGIC_REQ = b"RQ"
-_MAGIC_RESP = b"RS"
+_MAGIC_REQ = b"RQ"      # worker→coord: full request list
+_MAGIC_RESP = b"RS"     # coord→worker: full response list
+_MAGIC_HITS = b"CH"     # worker→coord: cache-hit bit list (fast path)
+_MAGIC_CACHE = b"CB"    # coord→worker: fused batches of cache bits
+_MAGIC_EVICT = b"EV"    # coord→worker: evicted cache bits
 
 
 def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
@@ -76,7 +85,9 @@ class CoordinatorServer:
                  port: int = 0, fusion_threshold: int = 64 << 20,
                  timeline=None, elastic: bool = False,
                  allow_ephemeral_fallback: bool = False,
-                 param_manager=None):
+                 param_manager=None, cache_capacity: int = 1024,
+                 stall_warning_time_s: float = 60.0,
+                 stall_shutdown_time_s: float = 0.0):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
@@ -95,10 +106,27 @@ class CoordinatorServer:
         self._departed_cond = threading.Condition()
         # tensor name -> element count, for fusion byte accounting
         self._elem_cache: Dict[str, int] = {}
+        # tensor name -> grouped-submission id (group-atomic fusion)
+        self._group_ids: Dict[str, int] = {}
         self._joined: Set[int] = set()
         self._last_joined = -1
         # barrier name -> ranks arrived
         self._barriers: Dict[str, Set[int]] = {}
+        # --- response-cache fast path (reference controller.cc:81-236) ---
+        self._cache = CoordinatorCache(cache_capacity)
+        # tensor name -> True while every contribution this round came
+        # from a live cache bit (a full request degrades the round)
+        self._bit_only: Dict[str, bool] = {}
+        self._pending_evictions: List[int] = []
+        self.stats = {"full_rounds": 0, "fast_rounds": 0,
+                      "fast_tensors": 0, "negotiated_tensors": 0}
+        # --- coordinator-side stall attribution (reference
+        #     stall_inspector.h:74-80: rank 0 names which ranks are
+        #     missing a tensor) ---
+        self._first_seen: Dict[str, float] = {}
+        self._stall_warning_s = stall_warning_time_s
+        self._stall_shutdown_s = stall_shutdown_time_s
+        self._stall_logged: Dict[str, float] = {}
         self._conns: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -125,6 +153,12 @@ class CoordinatorServer:
             target=self._accept_loop, name="hvd-coord-accept", daemon=True)
         self._threads: List[threading.Thread] = []
         self._accept_thread.start()
+        self._stall_thread = None
+        if stall_warning_time_s > 0:
+            self._stall_thread = threading.Thread(
+                target=self._stall_loop, name="hvd-coord-stall",
+                daemon=True)
+            self._stall_thread.start()
 
     def _accept_loop(self):
         self._srv.settimeout(0.5)
@@ -170,7 +204,10 @@ class CoordinatorServer:
                     frame = None
                 if frame is None:
                     return
-                _, payload = frame
+                magic, payload = frame
+                if magic == _MAGIC_HITS:
+                    self._handle_cache_hits(rank, unpack_bits(payload))
+                    continue
                 requests, shutdown = unpack_request_list(payload)
                 if shutdown:
                     clean = True
@@ -204,6 +241,8 @@ class CoordinatorServer:
                 list(self._barriers.keys())
             self._table.entries.clear()
             self._barriers.clear()
+            self._first_seen.clear()
+            self._bit_only.clear()
             msg = (f"rank {rank} left the job "
                    f"({'clean' if clean else 'connection lost'}); "
                    "membership changed")
@@ -215,15 +254,8 @@ class CoordinatorServer:
                 self._broadcast_locked(responses)
 
     def _broadcast_locked(self, responses: List[Response]):
-        payload = pack_response_list(responses)
-        dead = []
-        for r, conn in self._conns.items():
-            try:
-                _send_frame(conn, _MAGIC_RESP, payload)
-            except OSError:
-                dead.append(r)
-        for r in dead:
-            self._conns.pop(r, None)
+        self._broadcast_frame_locked(_MAGIC_RESP,
+                                     pack_response_list(responses))
 
     @staticmethod
     def _required_for(req: Request) -> int:
@@ -234,11 +266,11 @@ class CoordinatorServer:
             return len(self._joined & set(req.process_set_ranks))
         return len(self._joined)
 
-    def _scan_complete(self) -> List[Response]:
+    def _scan_complete(self) -> List[Tuple[str, List[Request]]]:
         """Re-scan the message table for tensors completed by a rank
         joining (the reference fires pending tensors when join
         participation changes, controller.cc:254-308)."""
-        ready: List[Response] = []
+        ready: List[Tuple[str, List[Request]]] = []
         for name in list(self._table.entries.keys()):
             msgs = self._table.entries[name]
             if not msgs:
@@ -246,82 +278,282 @@ class CoordinatorServer:
             required = self._required_for(msgs[0]) or self.size
             if len(msgs) + self._joined_count_for(msgs[0]) >= required:
                 self._table.pop(name)
-                ready.append(construct_response(
-                    name, msgs, self.size, self._joined))
+                self._first_seen.pop(name, None)
+                ready.append((name, msgs))
         return ready
 
     def _handle_requests(self, rank: int, requests: List[Request]):
-        """Accumulate; fire a fused broadcast with everything that became
-        ready (single-threaded per coordinator via the lock: ordering of
-        broadcast frames is the global execution order)."""
         with self._lock:
-            if self._broken:
-                # Membership already changed this epoch: every new
-                # request fails fast so submitters unwind promptly.
-                self._broadcast_locked([Response(
-                    response_type=ResponseType.ERROR,
-                    tensor_names=[req.tensor_name],
-                    error_message="membership changed; collective "
-                                  "cannot complete") for req in requests])
-                return
-            ready: List[Response] = []
-            for req in requests:
-                n = 1
-                for d in req.tensor_shape:
-                    n *= d
-                self._elem_cache[req.tensor_name] = n
-                if req.request_type == RequestType.JOIN:
-                    self._joined.add(rank)
-                    self._last_joined = rank
-                    if len(self._joined) == self.size:
-                        ready.append(Response(
-                            response_type=ResponseType.JOIN,
-                            tensor_names=["join"],
-                            last_joined_rank=self._last_joined))
-                        self._joined.clear()
-                    else:
-                        # Tensors waiting only on the joined rank are
-                        # now complete (zeros substituted).
-                        ready.extend(self._scan_complete())
+            self._process(rank, [(req, False) for req in requests])
+
+    def _handle_cache_hits(self, rank: int, bits: List[int]):
+        """Fast-path uplink: each bit is a full request the worker
+        elided because its cached signature still matches (reference:
+        CacheCoordinator::sync)."""
+        with self._lock:
+            items: List[Tuple[Request, bool]] = []
+            for bit in bits:
+                resolved = self._cache.resolve_bit(bit)
+                if resolved is None:
+                    # Only possible if >TOMBSTONE_CAP evictions raced one
+                    # in-flight frame — effectively unreachable; the
+                    # sender's tensor would hang, so fail loudly.
+                    logger.error(
+                        "unresolvable cache bit %d from rank %d; "
+                        "protocol desync", bit, rank)
+                    self._broadcast_locked([Response(
+                        response_type=ResponseType.ERROR,
+                        tensor_names=[f"__cache_bit_{bit}"],
+                        error_message="response-cache protocol desync")])
                     continue
-                if req.request_type == RequestType.BARRIER:
-                    required = self._required_for(req) or self.size
-                    arrived = self._barriers.setdefault(
-                        req.tensor_name, set())
-                    arrived.add(rank)
-                    if len(arrived) >= required:
-                        del self._barriers[req.tensor_name]
-                        ready.append(Response(
-                            response_type=ResponseType.BARRIER,
-                            tensor_names=[req.tensor_name],
-                            process_set_id=req.process_set_id,
-                            process_set_ranks=req.process_set_ranks))
-                    continue
+                live, name, sig, sizes, gid = resolved
+                first_dim = None
+                if sig[7] == int(RequestType.ALLGATHER) and sizes and \
+                        0 <= rank < len(sizes):
+                    first_dim = sizes[rank]
+                req = signature_to_request(sig, rank, name, first_dim)
+                req.group_id = gid
+                # A tombstoned bit still counts as a contribution, but
+                # forces the full (renegotiation) path.
+                items.append((req, live))
+            if items:
+                self._process(rank, items)
+
+    def _process(self, rank: int, items: List[Tuple[Request, bool]]):
+        """Accumulate; fire fused broadcasts with everything that became
+        ready (single-threaded per coordinator via the lock: ordering of
+        broadcast frames is the global execution order).  Caller holds
+        self._lock."""
+        if self._broken:
+            # Membership already changed this epoch: every new
+            # request fails fast so submitters unwind promptly.
+            self._broadcast_locked([Response(
+                response_type=ResponseType.ERROR,
+                tensor_names=[req.tensor_name],
+                error_message="membership changed; collective "
+                              "cannot complete")
+                for req, _ in items])
+            return
+        ready: List[Tuple[str, Optional[List[Request]], Optional[Response]]] = []
+        for req, from_cache in items:
+            name = req.tensor_name
+            n = 1
+            for d in req.tensor_shape:
+                n *= d
+            self._elem_cache[name] = n
+            self._group_ids[name] = req.group_id
+            if req.request_type == RequestType.JOIN:
+                self._joined.add(rank)
+                self._last_joined = rank
+                if len(self._joined) == self.size:
+                    ready.append((name, None, Response(
+                        response_type=ResponseType.JOIN,
+                        tensor_names=["join"],
+                        last_joined_rank=self._last_joined)))
+                    self._joined.clear()
+                else:
+                    # Tensors waiting only on the joined rank are
+                    # now complete (zeros substituted).
+                    for cname, msgs in self._scan_complete():
+                        ready.append((cname, msgs, None))
+                continue
+            if req.request_type == RequestType.BARRIER:
                 required = self._required_for(req) or self.size
-                complete = self._table.increment(
-                    req, required,
-                    joined_count=self._joined_count_for(req))
-                if self.timeline:
-                    self.timeline.negotiate_rank_ready(
-                        req.tensor_name, rank)
-                if complete:
-                    msgs = self._table.pop(req.tensor_name)
-                    ready.append(construct_response(
-                        req.tensor_name, msgs, self.size, self._joined))
-            if not ready:
-                return
-            fused = fuse_responses(ready, self._elem_cache,
-                                   self.fusion_threshold)
+                arrived = self._barriers.setdefault(name, set())
+                arrived.add(rank)
+                if len(arrived) >= required:
+                    del self._barriers[name]
+                    ready.append((name, None, Response(
+                        response_type=ResponseType.BARRIER,
+                        tensor_names=[name],
+                        process_set_id=req.process_set_id,
+                        process_set_ranks=req.process_set_ranks)))
+                continue
+            if not from_cache:
+                self._bit_only[name] = False
+                if self._cache.has(name):
+                    # Signature changed on some rank (or it evicted
+                    # locally): renegotiate from scratch so the cached
+                    # response can never serve a stale shape/dtype
+                    # (reference: INVALID → eviction,
+                    # response_cache.cc:49-87).
+                    bit = self._cache.evict_name(name)
+                    if bit is not None:
+                        self._pending_evictions.append(bit)
+            else:
+                self._bit_only.setdefault(name, True)
+            required = self._required_for(req) or self.size
+            self._first_seen.setdefault(name, time.monotonic())
+            complete = self._table.increment(
+                req, required,
+                joined_count=self._joined_count_for(req))
+            if self.timeline:
+                self.timeline.negotiate_rank_ready(name, rank)
+            if complete:
+                msgs = self._table.pop(name)
+                self._first_seen.pop(name, None)
+                ready.append((name, msgs, None))
+        if not ready:
+            self._flush_evictions_locked()
+            return
+
+        # Partition completed tensors: pure-bit rounds ride the compact
+        # CB frame; anything else is (re)negotiated and re-cached.  A
+        # grouped submission must not straddle the two frames (group
+        # atomicity): if any member renegotiates, every member of that
+        # group is demoted to the full path this round.
+        full_groups: Set[int] = set()
+        for name, msgs, direct in ready:
+            if direct is None and not (
+                    self._bit_only.get(name, False) and
+                    self._cache.has(name)):
+                gid = self._group_ids.get(name, -1)
+                if gid >= 0:
+                    full_groups.add(gid)
+        hit_responses: List[Response] = []
+        full_responses: List[Response] = []
+        sig_by_name: Dict[str, tuple] = {}
+        for name, msgs, direct in ready:
+            if direct is not None:
+                full_responses.append(direct)
+                continue
+            bit_only = self._bit_only.pop(name, False)
+            self._stall_logged.pop(name, None)
+            ent = self._cache.get(name)
+            if bit_only and ent is not None and \
+                    self._group_ids.get(name, -1) not in full_groups:
+                hit_responses.append(ent[1])
+                self.stats["fast_tensors"] += 1
+                continue
+            resp = construct_response(name, msgs, self.size, self._joined)
+            sig_by_name[name] = request_signature(msgs[0])
+            full_responses.append(resp)
+            self.stats["negotiated_tensors"] += 1
+            self._cache.clear_tombstones_for(name)
+
+        nbytes = 0
+        if hit_responses:
+            fused_hits = fuse_responses(
+                hit_responses, self._elem_cache, self.fusion_threshold,
+                self._group_ids)
+            batches = [[self._cache.get(n)[0] for n in fr.tensor_names]
+                       for fr in fused_hits]
+            payload = pack_bit_batches(batches)
+            self._broadcast_frame_locked(_MAGIC_CACHE, payload)
+            self.stats["fast_rounds"] += 1
+            nbytes += sum(self._elem_cache.get(n, 0) *
+                          dtype_size(fr.tensor_type)
+                          for fr in fused_hits for n in fr.tensor_names)
+        if full_responses:
+            fused = fuse_responses(full_responses, self._elem_cache,
+                                   self.fusion_threshold, self._group_ids)
+            if self._cache.enabled:
+                self._assign_cache_bits(fused, sig_by_name)
+            self._flush_evictions_locked()
             self._broadcast_locked(fused)
-            if self.param_manager is not None and \
-                    self.param_manager.active:
-                nbytes = sum(
-                    self._elem_cache.get(name, 0) *
-                    dtype_size(resp.tensor_type)
-                    for resp in fused for name in resp.tensor_names)
-                self.param_manager.record_step(nbytes)
-                self.fusion_threshold = \
-                    self.param_manager.fusion_threshold_bytes
+            self.stats["full_rounds"] += 1
+            nbytes += sum(self._elem_cache.get(n, 0) *
+                          dtype_size(fr.tensor_type)
+                          for fr in fused for n in fr.tensor_names)
+        else:
+            self._flush_evictions_locked()
+        if self.param_manager is not None and self.param_manager.active:
+            self.param_manager.record_step(nbytes)
+            self.fusion_threshold = \
+                self.param_manager.fusion_threshold_bytes
+
+    def _assign_cache_bits(self, fused: List[Response],
+                           sig_by_name: Dict[str, tuple]):
+        """Seed the cache from freshly negotiated responses and stamp
+        the coordinator-assigned bits onto the wire."""
+        pending = set(self._table.entries.keys())
+        for resp in fused:
+            if resp.response_type not in CACHEABLE or resp.error_message:
+                continue
+            parts = split_response(resp, self.size)
+            bits = []
+            for i, name in enumerate(resp.tensor_names):
+                sig = sig_by_name.get(name)
+                if sig is None:
+                    bits.append(-1)
+                    continue
+                bit, evicted = self._cache.insert(
+                    name, parts[i], sig, self._group_ids.get(name, -1),
+                    pending)
+                bits.append(bit)
+                self._pending_evictions.extend(evicted)
+            resp.cache_bits = bits
+
+    def _flush_evictions_locked(self):
+        if self._pending_evictions:
+            self._broadcast_frame_locked(
+                _MAGIC_EVICT, pack_bits(self._pending_evictions))
+            self._pending_evictions = []
+
+    def _broadcast_frame_locked(self, magic: bytes, payload: bytes):
+        dead = []
+        for r, conn in self._conns.items():
+            try:
+                _send_frame(conn, magic, payload)
+            except OSError:
+                dead.append(r)
+        for r in dead:
+            self._conns.pop(r, None)
+
+    # ------------------------------------------------------------------
+    # stall attribution (reference stall_inspector.{h,cc}: rank-0 names
+    # which ranks submitted a tensor and which did not)
+    # ------------------------------------------------------------------
+    def stall_report(self) -> List[Tuple[str, List[int], List[int], float]]:
+        """(tensor, submitted_ranks, missing_ranks, age_s) for every
+        tensor pending longer than the warning threshold."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for name, msgs in self._table.entries.items():
+                if not msgs:
+                    continue
+                ts = self._first_seen.get(name)
+                if ts is None or now - ts < self._stall_warning_s:
+                    continue
+                submitted = sorted({m.request_rank for m in msgs})
+                members = msgs[0].process_set_ranks or range(self.size)
+                missing = sorted(set(members) - set(submitted)
+                                 - self._joined)
+                out.append((name, submitted, missing, now - ts))
+        return out
+
+    def _stall_loop(self):
+        interval = max(min(self._stall_warning_s / 2.0, 10.0), 0.25)
+        while not self._stop.wait(interval):
+            for name, submitted, missing, age in self.stall_report():
+                last = self._stall_logged.get(name, 0.0)
+                if age - last < self._stall_warning_s and last > 0:
+                    continue
+                self._stall_logged[name] = age
+                logger.warning(
+                    "STALL: tensor %s — ranks %s submitted, ranks %s "
+                    "have not, for %.0fs. One or more ranks may be "
+                    "running a different graph or have hung.",
+                    name, submitted, missing, age)
+                if 0 < self._stall_shutdown_s <= age:
+                    logger.error(
+                        "stalled tensor %s exceeded shutdown threshold "
+                        "(%.0fs); failing the collective", name,
+                        self._stall_shutdown_s)
+                    with self._lock:
+                        msgs = self._table.pop(name)
+                        self._first_seen.pop(name, None)
+                        self._bit_only.pop(name, None)
+                        if msgs:
+                            self._broadcast_locked([Response(
+                                response_type=ResponseType.ERROR,
+                                tensor_names=[name],
+                                error_message=(
+                                    f"collective {name} stalled: ranks "
+                                    f"{missing} never submitted it "
+                                    f"within {self._stall_shutdown_s:.0f}"
+                                    "s"))])
 
     def stop(self):
         self._stop.set()
@@ -348,6 +580,13 @@ class NetworkController(Controller):
         self.server: Optional[CoordinatorServer] = None
         self._closing = False
         self._broken_err: Optional[Exception] = None
+        # Worker-side response cache (fast-path uplink/downlink); the
+        # coordinator owns bit assignment, we just follow the RS frames.
+        self.cache = WorkerResponseCache(state.knobs.cache_capacity)
+        self._sent_sigs: Dict[str, tuple] = {}
+        self.stats = {"rq_frames": 0, "ch_frames": 0, "rs_frames": 0,
+                      "cb_frames": 0, "ev_frames": 0,
+                      "bytes_sent": 0, "bytes_recv": 0}
         addr = os.environ.get(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
@@ -392,6 +631,8 @@ class NetworkController(Controller):
         also used when a timeline is active (negotiation spans are
         recorded coordinator-side)."""
         allow_ephemeral = self._rendezvous_client() is not None
+        stall_warn = 0.0 if state.knobs.stall_check_disable else \
+            state.knobs.stall_warning_time_s
         if state.timeline is None:
             try:
                 from ..native import NativeCoordinatorServer, available
@@ -402,7 +643,11 @@ class NetworkController(Controller):
                             state.knobs.fusion_threshold_bytes),
                         elastic=state.knobs.elastic,
                         allow_ephemeral_fallback=allow_ephemeral,
-                        param_manager=param_manager)
+                        param_manager=param_manager,
+                        cache_capacity=state.knobs.cache_capacity,
+                        stall_warning_time_s=stall_warn,
+                        stall_shutdown_time_s=(
+                            state.knobs.stall_shutdown_time_s))
             except OSError:
                 raise   # bind failure: same semantics as Python server
             except Exception:
@@ -414,7 +659,10 @@ class NetworkController(Controller):
             timeline=state.timeline,
             elastic=state.knobs.elastic,
             allow_ephemeral_fallback=allow_ephemeral,
-            param_manager=param_manager)
+            param_manager=param_manager,
+            cache_capacity=state.knobs.cache_capacity,
+            stall_warning_time_s=stall_warn,
+            stall_shutdown_time_s=state.knobs.stall_shutdown_time_s)
 
     @staticmethod
     def _rendezvous_client():
@@ -494,18 +742,88 @@ class NetworkController(Controller):
                         "connection to the coordinator was lost "
                         "(membership changed or rank 0 exited)")
                 return
-            _, payload = frame
+            magic, payload = frame
+            self.stats["bytes_recv"] += len(payload) + 6
+            if magic == _MAGIC_CACHE:
+                self.stats["cb_frames"] += 1
+                responses = self._reconstruct_cached(
+                    unpack_bit_batches(payload))
+                if responses is None:
+                    return  # desync; _broken_err set
+                self._recv_buf.put(responses)
+                continue
+            if magic == _MAGIC_EVICT:
+                self.stats["ev_frames"] += 1
+                self.cache.evict_bits(unpack_bits(payload))
+                continue
+            self.stats["rs_frames"] += 1
             responses, _ = unpack_response_list(payload)
+            self._seed_cache(responses)
             self._recv_buf.put(responses)
+
+    def _seed_cache(self, responses: List[Response]):
+        """Store per-tensor slices of newly negotiated responses under
+        the coordinator-assigned bits.  Entries for tensors this rank
+        never submitted (process-set non-members, joined ranks) carry no
+        signature: they resolve CB bits but never produce hits."""
+        if not self.cache.enabled:
+            return
+        for resp in responses:
+            if resp.response_type not in CACHEABLE or not resp.cache_bits:
+                continue
+            parts = split_response(resp, self.size)
+            for i, name in enumerate(resp.tensor_names):
+                bit = resp.cache_bits[i] if i < len(resp.cache_bits) else -1
+                if bit < 0:
+                    continue
+                self.cache.insert(name, bit, parts[i],
+                                  self._sent_sigs.get(name))
+
+    def _reconstruct_cached(self, batches: List[List[int]]
+                            ) -> Optional[List[Response]]:
+        """CB frame: rebuild the fused responses from the local cache.
+        By protocol a CB batch only fires when every member rank
+        contributed via bit, which implies every rank (member or not)
+        still holds the entries — an unknown bit is a hard desync."""
+        responses = []
+        for batch in batches:
+            parts = [self.cache.response_for_bit(b) for b in batch]
+            if any(p is None for p in parts):
+                from .exceptions import HorovodInternalError
+                self._broken_err = HorovodInternalError(
+                    "response-cache desync: coordinator referenced a "
+                    "cache bit this rank does not hold")
+                return None
+            responses.append(merge_responses(parts))
+        return responses
 
     def compute_response_list(self, pending, entry_sizes, threshold_bytes):
         if self._broken_err is not None:
             raise self._broken_err
         if pending:
+            hit_bits: List[int] = []
+            full: List[Request] = []
+            for req in pending:
+                bit = self.cache.lookup_bit(req) \
+                    if self.cache.enabled else None
+                if bit is not None:
+                    hit_bits.append(bit)
+                else:
+                    full.append(req)
+                    self._sent_sigs[req.tensor_name] = \
+                        request_signature(req)
             try:
                 with self._send_lock:
-                    _send_frame(self._sock, _MAGIC_REQ,
-                                pack_request_list(pending))
+                    if hit_bits:
+                        payload = pack_bits(hit_bits)
+                        _send_frame(self._sock, _MAGIC_HITS, payload)
+                        self.stats["ch_frames"] += 1
+                        self.stats["bytes_sent"] += len(payload) + 6
+                    if full:
+                        payload = pack_request_list(full)
+                        _send_frame(self._sock, _MAGIC_REQ, payload)
+                        self.stats["rq_frames"] += 1
+                        self.stats["bytes_sent"] += len(payload) + 6
             except OSError as e:
                 from .exceptions import HorovodInternalError
                 raise HorovodInternalError(

@@ -42,17 +42,64 @@ def _can_fuse(a: Response, b: Response) -> bool:
             and a.reduce_op == b.reduce_op)
 
 
+def _merge(a: Response, b: Response) -> Response:
+    return Response(
+        response_type=a.response_type,
+        tensor_names=a.tensor_names + b.tensor_names,
+        tensor_type=a.tensor_type,
+        devices=a.devices,
+        tensor_sizes=a.tensor_sizes + b.tensor_sizes,
+        prescale_factor=a.prescale_factor,
+        postscale_factor=a.postscale_factor,
+        process_set_id=a.process_set_id,
+        reduce_op=a.reduce_op,
+        root_rank=a.root_rank,
+        tensor_shapes=a.tensor_shapes + b.tensor_shapes,
+        process_set_ranks=a.process_set_ranks,
+    )
+
+
+def _premerge_groups(responses: List[Response], group_ids) -> List[Response]:
+    """Merge members of one grouped submission into a single response
+    BEFORE threshold-bounded fusion, so a group is never split across
+    compiled programs even when it exceeds the threshold (reference
+    keeps groups together via the group table, controller.cc:199-223).
+    Members of mixed dtype/op stay separate (they could not share one
+    fused buffer anyway); order is anchored at each group's first
+    member."""
+    merged: List[Response] = []
+    index = {}  # (group_id, fuse key) -> position in merged
+    for resp in responses:
+        gid = -1
+        if resp.tensor_names and group_ids:
+            gid = group_ids.get(resp.tensor_names[0], -1)
+        if gid < 0 or resp.response_type not in _FUSABLE:
+            merged.append(resp)
+            continue
+        key = (gid, resp.response_type, resp.tensor_type,
+               resp.process_set_id, resp.prescale_factor,
+               resp.postscale_factor, resp.reduce_op)
+        pos = index.get(key)
+        if pos is None:
+            index[key] = len(merged)
+            merged.append(resp)
+        else:
+            merged[pos] = _merge(merged[pos], resp)
+    return merged
+
+
 def fuse_responses(responses: List[Response], entry_sizes,
-                   threshold_bytes: int) -> List[Response]:
+                   threshold_bytes: int, group_ids=None) -> List[Response]:
     """Greedy fusion with look-ahead skip.
 
-    ``entry_sizes`` maps tensor name → element count.  Responses that
-    cannot fuse (broadcast, alltoall, errors, joins) pass through
-    unchanged, preserving overall order determinism so every rank builds
-    the identical plan.
+    ``entry_sizes`` maps tensor name → element count; ``group_ids``
+    (optional) maps tensor name → grouped-submission id for group
+    atomicity.  Responses that cannot fuse (broadcast, alltoall, errors,
+    joins) pass through unchanged, preserving overall order determinism
+    so every rank builds the identical plan.
     """
     out: List[Response] = []
-    queue = list(responses)
+    queue = _premerge_groups(responses, group_ids)
     while queue:
         base = queue.pop(0)
         if base.response_type not in _FUSABLE:
@@ -67,21 +114,7 @@ def fuse_responses(responses: List[Response], entry_sizes,
             if _can_fuse(fused, cand):
                 cand_bytes = response_bytes(cand, entry_sizes)
                 if acc_bytes + cand_bytes <= threshold_bytes:
-                    fused = Response(
-                        response_type=fused.response_type,
-                        tensor_names=fused.tensor_names + cand.tensor_names,
-                        tensor_type=fused.tensor_type,
-                        devices=fused.devices,
-                        tensor_sizes=fused.tensor_sizes + cand.tensor_sizes,
-                        prescale_factor=fused.prescale_factor,
-                        postscale_factor=fused.postscale_factor,
-                        process_set_id=fused.process_set_id,
-                        reduce_op=fused.reduce_op,
-                        root_rank=fused.root_rank,
-                        tensor_shapes=(fused.tensor_shapes +
-                                       cand.tensor_shapes),
-                        process_set_ranks=fused.process_set_ranks,
-                    )
+                    fused = _merge(fused, cand)
                     acc_bytes += cand_bytes
                     queue.pop(i)
                     continue

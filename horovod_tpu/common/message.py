@@ -113,6 +113,10 @@ class Request:
     # the wire so the coordinator knows the required count without a
     # separate registration protocol.
     process_set_ranks: Tuple[int, ...] = ()
+    # Grouped-submission id (-1 = ungrouped).  Members of one group are
+    # kept atomic by the fusion planner even past the fusion threshold
+    # (reference: group_table.{h,cc}, controller.cc:199-223).
+    group_id: int = -1
 
     def nbytes(self) -> int:
         n = 1
@@ -126,20 +130,20 @@ class Request:
         shape = self.tensor_shape
         psr = self.process_set_ranks
         head = struct.pack(
-            "<iiiiiddiiHHH", self.request_rank, int(self.request_type),
+            "<iiiiiddiiiHHH", self.request_rank, int(self.request_type),
             int(self.tensor_type), self.root_rank, self.device,
             self.prescale_factor, self.postscale_factor,
-            self.process_set_id, len(shape), len(name_b), len(op_b),
-            len(psr))
+            self.process_set_id, self.group_id, len(shape), len(name_b),
+            len(op_b), len(psr))
         return (head + struct.pack(f"<{len(shape)}q", *shape) + name_b +
                 op_b + struct.pack(f"<{len(psr)}i", *psr))
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Request":
-        head_fmt = "<iiiiiddiiHHH"
+        head_fmt = "<iiiiiddiiiHHH"
         head_size = struct.calcsize(head_fmt)
-        (rank, rtype, dtype, root, device, pre, post, psid, ndim,
-         name_len, op_len, n_psr) = struct.unpack_from(head_fmt, data)
+        (rank, rtype, dtype, root, device, pre, post, psid, group_id,
+         ndim, name_len, op_len, n_psr) = struct.unpack_from(head_fmt, data)
         off = head_size
         shape = struct.unpack_from(f"<{ndim}q", data, off)
         off += 8 * ndim
@@ -153,7 +157,7 @@ class Request:
                    tensor_type=DataType(dtype), root_rank=root,
                    device=device, prescale_factor=pre, postscale_factor=post,
                    process_set_id=psid, reduce_op=op,
-                   process_set_ranks=tuple(psr))
+                   process_set_ranks=tuple(psr), group_id=group_id)
 
 
 @dataclass
@@ -177,19 +181,26 @@ class Response:
     # reference collective_operations.h:259-276).
     tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
     process_set_ranks: Tuple[int, ...] = ()
+    # Coordinator-assigned response-cache bit per tensor (aligned with
+    # tensor_names; -1 or empty = uncached).  The coordinator owns bit
+    # assignment, so workers never have to agree on cache eviction order
+    # (unlike the reference, where identical LRU caches are maintained by
+    # symmetric bitvector sync — response_cache.h:107-169).
+    cache_bits: List[int] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         err_b = self.error_message.encode()
         op_b = self.reduce_op.encode()
         names_b = [n.encode() for n in self.tensor_names]
         psr = self.process_set_ranks
+        bits = self.cache_bits
         head = struct.pack(
-            "<iiddiiiHHHHHH", int(self.response_type),
+            "<iiddiiiHHHHHHH", int(self.response_type),
             int(self.tensor_type),
             self.prescale_factor, self.postscale_factor,
             self.process_set_id, self.root_rank, self.last_joined_rank,
             len(names_b), len(self.tensor_sizes), len(err_b), len(op_b),
-            len(self.tensor_shapes), len(psr))
+            len(self.tensor_shapes), len(psr), len(bits))
         parts = [head]
         for nb in names_b:
             parts.append(struct.pack("<H", len(nb)))
@@ -202,14 +213,15 @@ class Response:
             parts.append(struct.pack("<H", len(shape)))
             parts.append(struct.pack(f"<{len(shape)}q", *shape))
         parts.append(struct.pack(f"<{len(psr)}i", *psr))
+        parts.append(struct.pack(f"<{len(bits)}i", *bits))
         return b"".join(parts)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Response":
-        head_fmt = "<iiddiiiHHHHHH"
+        head_fmt = "<iiddiiiHHHHHHH"
         (rtype, dtype, pre, post, psid, root, last_joined, n_names,
-         n_sizes, err_len, op_len, n_shapes,
-         n_psr) = struct.unpack_from(head_fmt, data)
+         n_sizes, err_len, op_len, n_shapes, n_psr,
+         n_bits) = struct.unpack_from(head_fmt, data)
         off = struct.calcsize(head_fmt)
         names = []
         for _ in range(n_names):
@@ -230,13 +242,15 @@ class Response:
             shapes.append(tuple(struct.unpack_from(f"<{nd}q", data, off)))
             off += 8 * nd
         psr = tuple(struct.unpack_from(f"<{n_psr}i", data, off))
+        off += 4 * n_psr
+        bits = list(struct.unpack_from(f"<{n_bits}i", data, off))
         return cls(response_type=ResponseType(rtype),
                    tensor_type=DataType(dtype), prescale_factor=pre,
                    postscale_factor=post, process_set_id=psid,
                    root_rank=root, last_joined_rank=last_joined,
                    tensor_names=names, tensor_sizes=sizes,
                    error_message=err, reduce_op=op, tensor_shapes=shapes,
-                   process_set_ranks=psr)
+                   process_set_ranks=psr, cache_bits=bits)
 
 
 def pack_request_list(requests: List[Request],
@@ -281,3 +295,40 @@ def unpack_response_list(data: bytes) -> Tuple[List[Response], bool]:
         out.append(Response.from_bytes(data[off:off + ln]))
         off += ln
     return out, shutdown
+
+
+# ---------------------------------------------------------------------------
+# Response-cache fast-path frames.  These replace full request/response
+# lists in the steady state (the analog of the reference's bitvector
+# cache sync, response_cache.cc:49-87 / controller.cc:81-236): a cache
+# bit is 4 bytes on the wire vs ~100 for a full Request/Response.
+# ---------------------------------------------------------------------------
+def pack_bits(bits: List[int]) -> bytes:
+    """CH (worker→coordinator cache hits) / EV (evictions) payload."""
+    return struct.pack(f"<I{len(bits)}I", len(bits), *bits)
+
+
+def unpack_bits(data: bytes) -> List[int]:
+    (n,) = struct.unpack_from("<I", data)
+    return list(struct.unpack_from(f"<{n}I", data, 4))
+
+
+def pack_bit_batches(batches: List[List[int]]) -> bytes:
+    """CB (coordinator→worker) payload: fused batches of cache bits, in
+    execution order.  Each batch maps to ONE fused collective program."""
+    parts = [struct.pack("<I", len(batches))]
+    for batch in batches:
+        parts.append(struct.pack(f"<I{len(batch)}I", len(batch), *batch))
+    return b"".join(parts)
+
+
+def unpack_bit_batches(data: bytes) -> List[List[int]]:
+    (nb,) = struct.unpack_from("<I", data)
+    off = 4
+    out = []
+    for _ in range(nb):
+        (n,) = struct.unpack_from("<I", data, off)
+        off += 4
+        out.append(list(struct.unpack_from(f"<{n}I", data, off)))
+        off += 4 * n
+    return out

@@ -1,10 +1,32 @@
-"""LRU response cache with bit-indexed coordination.
+"""Response cache: the negotiation fast path.
 
-Mirrors the reference response cache (reference: response_cache.{h,cc}:
-ResponseCache :45-102 — LRU keyed by tensor name, HIT only when
-device/dtype/shape/scale all match, else INVALID → eviction; and
-CacheCoordinator :107-169 — workers exchange hit bitvectors with one or
-two bitwise-AND allreduces instead of a full negotiation round).
+The analog of the reference response cache (reference: response_cache.{h,cc}:
+ResponseCache :45-102 — cache keyed by tensor name, HIT only when
+device/dtype/shape/scale all match, else INVALID → renegotiation; and
+CacheCoordinator :107-169 — in the reference, workers exchange hit
+bitvectors with one or two bitwise-AND allreduces instead of a full
+negotiation round; fast path in controller.cc:81-236).
+
+This build's control plane is a star (workers push to a rank-0
+coordinator over TCP), so the fast path is framed differently but buys
+the same thing — O(small-constant) control bytes per steady-state step
+instead of O(tensors) full request/response payloads:
+
+  * The COORDINATOR owns bit assignment.  When it broadcasts a newly
+    negotiated Response it attaches a fresh ``cache_bits`` entry per
+    tensor; every worker stores the per-tensor response under that bit.
+    Because bits are assigned in exactly one place, workers never have
+    to agree on LRU/eviction order (the subtle invariant the reference
+    maintains with symmetric caches + bitvector sync).
+  * Workers whose next request for a tensor matches the cached
+    signature send a 4-byte bit (CH frame) instead of the full request.
+  * When EVERY participating rank contributed via bit, the coordinator
+    broadcasts a CB frame: fused batches of bits in execution order.
+    Workers reconstruct the fused Response locally from their caches.
+  * Any full request for a cached tensor (signature change, worker-side
+    eviction) forces the coordinator to evict + renegotiate, and the
+    re-broadcast re-seeds everyone — self-healing, no eviction
+    consensus needed.  EV frames bound worker cache growth.
 
 On TPU the cache is *load-bearing*: a cache hit means the fused batch
 signature is unchanged, so the compiled XLA executable for the batch is
@@ -12,131 +34,263 @@ reused without recompilation (SURVEY §7: response-cache hits map to
 executable-cache hits).
 """
 
-import enum
+import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Tuple
 
-from .message import Request, Response
+from .message import Request, RequestType, Response, ResponseType
+
+# Response types that participate in the cache (JOIN/BARRIER/ERROR are
+# control-flow, never cached — reference response_cache.cc caches the
+# data collectives only).
+CACHEABLE = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
+             ResponseType.ALLGATHER, ResponseType.BROADCAST,
+             ResponseType.ALLTOALL, ResponseType.REDUCESCATTER}
+
+_RESP_TO_REQ = {
+    ResponseType.ALLREDUCE: RequestType.ALLREDUCE,
+    ResponseType.ALLGATHER: RequestType.ALLGATHER,
+    ResponseType.BROADCAST: RequestType.BROADCAST,
+    ResponseType.ADASUM: RequestType.ADASUM,
+    ResponseType.ALLTOALL: RequestType.ALLTOALL,
+    ResponseType.REDUCESCATTER: RequestType.REDUCESCATTER,
+}
 
 
-class CacheState(enum.IntEnum):
-    MISS = 0
-    HIT = 1
-    INVALID = 2
+def request_signature(req: Request) -> tuple:
+    """Everything that must be unchanged for a cached response to be
+    valid for this rank (reference response_cache.cc:49-87 checks
+    device/dtype/shape/prescale/postscale)."""
+    return (tuple(req.tensor_shape), int(req.tensor_type), req.root_rank,
+            req.prescale_factor, req.postscale_factor,
+            req.process_set_id, req.reduce_op, int(req.request_type),
+            tuple(req.process_set_ranks))
 
 
-class ResponseCache:
+def signature_to_request(sig: tuple, rank: int, name: str,
+                         first_dim: Optional[int] = None) -> Request:
+    """Reconstruct a Request from a cached signature (coordinator side:
+    used when a cache-bit contribution must be merged with full requests
+    in a degraded round).  ``first_dim`` overrides shape[0] for ops with
+    per-rank first dimensions (allgather)."""
+    (shape, dtype, root, pre, post, psid, op, rtype, psr) = sig
+    if first_dim is not None and shape:
+        shape = (first_dim,) + tuple(shape[1:])
+    return Request(request_rank=rank, request_type=RequestType(rtype),
+                   tensor_name=name, tensor_shape=tuple(shape),
+                   tensor_type=dtype, root_rank=root, prescale_factor=pre,
+                   postscale_factor=post, process_set_id=psid,
+                   reduce_op=op, process_set_ranks=tuple(psr))
+
+
+def split_response(resp: Response, world_size: int) -> List[Response]:
+    """Slice a (possibly fused) Response into per-tensor responses.
+
+    For fused allgathers the tensor_sizes list is the concatenation of
+    per-rank row counts per tensor (``world_size`` entries each, see
+    fusion.py) — slice accordingly.
+    """
+    out = []
+    per_sizes = 0
+    if resp.response_type == ResponseType.ALLGATHER and world_size > 0 \
+            and len(resp.tensor_sizes) == world_size * len(resp.tensor_names):
+        per_sizes = world_size
+    for i, name in enumerate(resp.tensor_names):
+        out.append(Response(
+            response_type=resp.response_type,
+            tensor_names=[name],
+            tensor_type=resp.tensor_type,
+            tensor_sizes=(resp.tensor_sizes[i * per_sizes:
+                                            (i + 1) * per_sizes]
+                          if per_sizes else list(resp.tensor_sizes)),
+            prescale_factor=resp.prescale_factor,
+            postscale_factor=resp.postscale_factor,
+            process_set_id=resp.process_set_id,
+            root_rank=resp.root_rank,
+            reduce_op=resp.reduce_op,
+            tensor_shapes=([resp.tensor_shapes[i]]
+                           if i < len(resp.tensor_shapes) else []),
+            process_set_ranks=resp.process_set_ranks,
+        ))
+    return out
+
+
+def merge_responses(parts: List[Response]) -> Response:
+    """Merge per-tensor cached responses into one fused Response —
+    the worker-side inverse of the coordinator's fusion plan (must
+    mirror fusion.py's concatenation order exactly)."""
+    first = parts[0]
+    merged = Response(
+        response_type=first.response_type,
+        tensor_names=[], tensor_type=first.tensor_type,
+        tensor_sizes=[], prescale_factor=first.prescale_factor,
+        postscale_factor=first.postscale_factor,
+        process_set_id=first.process_set_id, root_rank=first.root_rank,
+        reduce_op=first.reduce_op, tensor_shapes=[],
+        process_set_ranks=first.process_set_ranks)
+    for p in parts:
+        merged.tensor_names.extend(p.tensor_names)
+        merged.tensor_sizes.extend(p.tensor_sizes)
+        merged.tensor_shapes.extend(p.tensor_shapes)
+    return merged
+
+
+class WorkerResponseCache:
+    """Per-rank cache: name → (coordinator bit, per-tensor response,
+    this rank's request signature).  Entries without a signature (this
+    rank never submitted the tensor — e.g. non-members of a process set,
+    joined ranks) still resolve CB bits but never produce hits."""
+
     def __init__(self, capacity: int = 1024):
         self.capacity = capacity
-        # name -> (bit, response, params signature)
-        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
-        self._bits_dirty = False
+        self._lock = threading.Lock()
+        # name -> [bit, response, sig-or-None]
+        self._entries: "OrderedDict[str, list]" = OrderedDict()
+        self._bit_names: Dict[int, str] = {}
 
-    def _signature(self, req: Request):
-        return (req.tensor_shape, req.tensor_type, req.root_rank,
-                req.prescale_factor, req.postscale_factor,
-                req.process_set_id, req.reduce_op, int(req.request_type))
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
 
-    def cached(self, req: Request) -> CacheState:
-        ent = self._entries.get(req.tensor_name)
-        if ent is None:
-            return CacheState.MISS
-        _, _, sig = ent
-        if sig != self._signature(req):
-            return CacheState.INVALID
-        return CacheState.HIT
+    def lookup_bit(self, req: Request) -> Optional[int]:
+        """Bit for a HIT, else None.  A signature mismatch (INVALID)
+        drops the local entry so the full request goes out and the
+        coordinator renegotiates."""
+        with self._lock:
+            ent = self._entries.get(req.tensor_name)
+            if ent is None:
+                return None
+            bit, _, sig = ent
+            if sig is None or sig != request_signature(req):
+                del self._entries[req.tensor_name]
+                self._bit_names.pop(bit, None)
+                return None
+            return bit
 
-    def put(self, req: Request, resp: Response):
-        if req.tensor_name in self._entries:
-            self._entries.move_to_end(req.tensor_name)
-            bit = self._entries[req.tensor_name][0]
-            self._entries[req.tensor_name] = (
-                bit, resp, self._signature(req))
-            return
-        if len(self._entries) >= self.capacity > 0:
-            self._entries.popitem(last=False)
-            self._bits_dirty = True
-        self._entries[req.tensor_name] = (
-            len(self._entries), resp, self._signature(req))
-        self._bits_dirty = True
+    def insert(self, name: str, bit: int, response: Response,
+               sig: Optional[tuple]):
+        with self._lock:
+            old = self._entries.pop(name, None)
+            if old is not None:
+                self._bit_names.pop(old[0], None)
+            while len(self._entries) >= self.capacity > 0:
+                _, (old_bit, _, _) = self._entries.popitem(last=False)
+                self._bit_names.pop(old_bit, None)
+            self._entries[name] = [bit, response, sig]
+            self._bit_names[bit] = name
 
-    def get_response(self, name: str) -> Optional[Response]:
-        ent = self._entries.get(name)
-        if ent is None:
-            return None
-        self._entries.move_to_end(name)
-        return ent[1]
+    def response_for_bit(self, bit: int) -> Optional[Response]:
+        with self._lock:
+            name = self._bit_names.get(bit)
+            if name is None:
+                return None
+            return self._entries[name][1]
 
-    def erase(self, name: str):
-        if name in self._entries:
-            del self._entries[name]
-            self._bits_dirty = True
+    def evict_bits(self, bits: List[int]):
+        with self._lock:
+            for b in bits:
+                name = self._bit_names.pop(b, None)
+                if name is not None:
+                    self._entries.pop(name, None)
 
-    def update_bits(self):
-        """Reassign dense bit positions after eviction (bit-index
-        compaction, as the reference does on capacity change)."""
-        if self._bits_dirty:
-            for i, (name, (_, resp, sig)) in enumerate(
-                    list(self._entries.items())):
-                self._entries[name] = (i, resp, sig)
-            self._bits_dirty = False
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
 
-    def peek_bit(self, name: str) -> Optional[int]:
-        ent = self._entries.get(name)
-        return None if ent is None else ent[0]
 
-    def name_of_bit(self, bit: int) -> Optional[str]:
-        for name, (b, _, _) in self._entries.items():
-            if b == bit:
-                return name
+class CoordinatorCache:
+    """Rank-0 cache: authoritative bit assignment + enough signature
+    state to synthesize a rank's request when a cache-bit contribution
+    lands in a degraded (partially-uncached) round.
+
+    Bits are monotonically increasing and never reused, so a late CH
+    frame racing an eviction still resolves through the tombstone map
+    (bounded FIFO; overflowing it would take ~64k evictions inside one
+    round-trip window)."""
+
+    TOMBSTONE_CAP = 65536
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        # name -> [bit, response(per-tensor), sig, group_id]
+        self._entries: "OrderedDict[str, list]" = OrderedDict()
+        self._bit_names: Dict[int, str] = {}
+        # bit -> (name, sig, sizes, group_id) for recently evicted bits
+        self._tombstones: "OrderedDict[int, tuple]" = OrderedDict()
+        self._next_bit = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def get(self, name: str) -> Optional[list]:
+        return self._entries.get(name)
+
+    def has(self, name: str) -> bool:
+        return name in self._entries
+
+    def resolve_bit(self, bit: int):
+        """Returns (live, name, sig, sizes, group_id) or None.  ``live``
+        False means the bit was evicted (tombstone): the contribution is
+        honored but forces the full negotiation path."""
+        name = self._bit_names.get(bit)
+        if name is not None:
+            ent = self._entries[name]
+            return True, name, ent[2], ent[1].tensor_sizes, ent[3]
+        tomb = self._tombstones.get(bit)
+        if tomb is not None:
+            return (False,) + tomb
         return None
 
-    def num_active_bits(self) -> int:
+    def insert(self, name: str, response: Response, sig: tuple,
+               group_id: int, pending_names=()) -> Tuple[int, List[int]]:
+        """Insert/replace; returns (bit, evicted_bits).  Capacity
+        eviction skips tensors with an in-flight negotiation round
+        (``pending_names``) so their bits stay resolvable."""
+        evicted: List[int] = []
+        old = self._entries.pop(name, None)
+        if old is not None:
+            self._tombstone(old[0], name, old[2],
+                            old[1].tensor_sizes, old[3])
+            self._bit_names.pop(old[0], None)
+            evicted.append(old[0])
+        while len(self._entries) >= self.capacity > 0:
+            victim = None
+            for cand in self._entries:
+                if cand not in pending_names:
+                    victim = cand
+                    break
+            if victim is None:
+                break  # everything in flight; let the cache overgrow
+            ent = self._entries.pop(victim)
+            self._tombstone(ent[0], victim, ent[2],
+                            ent[1].tensor_sizes, ent[3])
+            self._bit_names.pop(ent[0], None)
+            evicted.append(ent[0])
+        bit = self._next_bit
+        self._next_bit += 1
+        self._entries[name] = [bit, response, sig, group_id]
+        self._bit_names[bit] = name
+        return bit, evicted
+
+    def evict_name(self, name: str) -> Optional[int]:
+        ent = self._entries.pop(name, None)
+        if ent is None:
+            return None
+        bit, resp, sig, gid = ent
+        self._tombstone(bit, name, sig, resp.tensor_sizes, gid)
+        self._bit_names.pop(bit, None)
+        return bit
+
+    def _tombstone(self, bit, name, sig, sizes, gid):
+        self._tombstones[bit] = (name, sig, sizes, gid)
+        while len(self._tombstones) > self.TOMBSTONE_CAP:
+            self._tombstones.popitem(last=False)
+
+    def clear_tombstones_for(self, name: str):
+        dead = [b for b, t in self._tombstones.items() if t[0] == name]
+        for b in dead:
+            del self._tombstones[b]
+
+    def __len__(self):
         return len(self._entries)
-
-    def hit_bitvector(self, requests: List[Request]) -> Optional[int]:
-        """Bitvector of cache hits for this cycle's requests, or None if
-        any request MISSed/INVALIDated (forces full negotiation)."""
-        self.update_bits()
-        bits = 0
-        for req in requests:
-            state = self.cached(req)
-            if state != CacheState.HIT:
-                return None
-            bits |= 1 << self.peek_bit(req.tensor_name)
-        return bits
-
-    def responses_for_bits(self, bits: int) -> List[Response]:
-        self.update_bits()
-        out = []
-        for name, (b, resp, _) in self._entries.items():
-            if bits & (1 << b):
-                out.append(resp)
-        return out
-
-
-class CacheCoordinator:
-    """Aggregates per-rank hit/invalid bit sets; in multiprocess mode the
-    sets are combined with bitwise-AND/OR exchanges over the control
-    channel (reference: CacheCoordinator::sync)."""
-
-    def __init__(self):
-        self.hit_bits: Set[int] = set()
-        self.invalid_bits: Set[int] = set()
-        self.should_shutdown = False
-        self.uncached_in_queue = False
-
-    def record_hit(self, bit: int):
-        self.hit_bits.add(bit)
-
-    def record_invalid(self, bit: int):
-        self.invalid_bits.add(bit)
-        self.hit_bits.discard(bit)
-
-    def combine(self, others: List["CacheCoordinator"]):
-        for o in others:
-            self.hit_bits &= o.hit_bits
-            self.invalid_bits |= o.invalid_bits
-            self.should_shutdown |= o.should_shutdown
-            self.uncached_in_queue |= o.uncached_in_queue
-        self.hit_bits -= self.invalid_bits

@@ -16,6 +16,7 @@ TPU-specific deltas from the reference:
     (SURVEY §7), so cache hits skip negotiation AND recompilation.
 """
 
+import itertools
 import logging
 import threading
 import time
@@ -24,7 +25,6 @@ from typing import Dict, List, Optional
 from . import timeline as tl
 from .controller import LoopbackController
 from .message import (Request, RequestType, Response, ResponseType)
-from .response_cache import CacheState, ResponseCache
 from .stall_inspector import StallInspector
 from .tensor_queue import TensorQueue, TensorTableEntry
 
@@ -35,7 +35,11 @@ class BackgroundRuntime:
     def __init__(self, state):
         self.state = state
         self.tensor_queue = TensorQueue()
-        self.response_cache = ResponseCache(state.knobs.cache_capacity)
+        # Cross-rank group ids for grouped submissions (group-atomic
+        # fusion).  Monotonic per process; ranks agree because grouped
+        # collectives are submitted in the same order everywhere (the
+        # same ordering contract auto-generated tensor names rely on).
+        self._group_counter = itertools.count()
         self.stall_inspector = StallInspector(
             warning_time_s=state.knobs.stall_warning_time_s,
             shutdown_time_s=state.knobs.stall_shutdown_time_s,
@@ -82,7 +86,9 @@ class BackgroundRuntime:
                      entries: List[TensorTableEntry]):
         if self._error is not None:
             raise self._error
+        group_id = next(self._group_counter)
         for request in requests:
+            request.group_id = group_id
             nelem = 1
             for d in request.tensor_shape:
                 nelem *= d
@@ -137,11 +143,16 @@ class BackgroundRuntime:
         if leftovers:
             self.tensor_queue.push_back(leftovers)
         if self.stall_inspector is not None:
+            # Local watchdog only: this rank's own stuck submissions
+            # (e.g. unreachable coordinator).  Cross-rank attribution —
+            # "ranks a,b submitted X, ranks c,d did not" — lives on the
+            # rank-0 coordinator (controller_net.stall_report /
+            # native coordinator), matching the reference's rank-0
+            # stall inspector (stall_inspector.h:74-80).
             for req in pending:
                 self.stall_inspector.record_uncached_tensor(
                     req.tensor_name, req.request_rank)
-            for name in self.stall_inspector.check():
-                self.response_cache.erase(name)
+            self.stall_inspector.check()
         for resp in responses:
             self._perform_operation(resp)
 

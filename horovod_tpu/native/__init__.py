@@ -75,7 +75,8 @@ def load():
         lib.hvd_coord_create.restype = ctypes.c_void_p
         lib.hvd_coord_create.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-            ctypes.c_longlong, ctypes.c_int, ctypes.c_int]
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_double, ctypes.c_double]
         lib.hvd_coord_port.restype = ctypes.c_int
         lib.hvd_coord_port.argtypes = [ctypes.c_void_p]
         lib.hvd_coord_set_fusion.argtypes = [ctypes.c_void_p,
@@ -83,6 +84,12 @@ def load():
         lib.hvd_coord_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
             ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_coord_cache_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_coord_stall_report.restype = ctypes.c_int
+        lib.hvd_coord_stall_report.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
         lib.hvd_coord_stop.argtypes = [ctypes.c_void_p]
         lib.hvd_coord_counts.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
@@ -107,14 +114,17 @@ class NativeCoordinatorServer:
                  port: int = 0, fusion_threshold: int = 64 << 20,
                  elastic: bool = False,
                  allow_ephemeral_fallback: bool = False,
-                 param_manager=None):
+                 param_manager=None, cache_capacity: int = 1024,
+                 stall_warning_time_s: float = 60.0,
+                 stall_shutdown_time_s: float = 0.0):
         lib = load()
         if lib is None:
             raise RuntimeError("native coordinator unavailable")
         self._lib = lib
         self._handle = lib.hvd_coord_create(
             size, bind_addr.encode(), port, fusion_threshold,
-            1 if elastic else 0, 1 if allow_ephemeral_fallback else 0)
+            1 if elastic else 0, 1 if allow_ephemeral_fallback else 0,
+            cache_capacity, stall_warning_time_s, stall_shutdown_time_s)
         if not self._handle:
             raise OSError(
                 f"native coordinator could not bind port {port}")
@@ -160,6 +170,24 @@ class NativeCoordinatorServer:
         self._lib.hvd_coord_counts(self._handle, ctypes.byref(seen),
                                    ctypes.byref(departed))
         return seen.value, departed.value
+
+    def cache_stats(self):
+        """(fast_rounds, full_rounds) response-cache round counters."""
+        if not self._handle:
+            return 0, 0
+        fast = ctypes.c_longlong()
+        full = ctypes.c_longlong()
+        self._lib.hvd_coord_cache_stats(self._handle, ctypes.byref(fast),
+                                        ctypes.byref(full))
+        return fast.value, full.value
+
+    def stall_report(self) -> str:
+        """Coordinator-side stall attribution text ('' = no stalls)."""
+        if not self._handle:
+            return ""
+        buf = ctypes.create_string_buffer(65536)
+        n = self._lib.hvd_coord_stall_report(self._handle, buf, len(buf))
+        return buf.raw[:n].decode(errors="replace")
 
     def stop(self):
         self._stop.set()

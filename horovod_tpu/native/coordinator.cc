@@ -9,16 +9,29 @@
 // unchanged; the Python CoordinatorServer remains as a fallback when
 // the shared library is unavailable.
 //
+// Implements the response-cache fast path (reference:
+// response_cache.{h,cc}, fast path controller.cc:81-236) with
+// coordinator-authoritative bit assignment: steady-state steps exchange
+// 4-byte cache bits (CH uplink / CB downlink) instead of full
+// request/response lists.  Also: group-atomic fusion (reference
+// group_table.{h,cc}, controller.cc:199-223) and rank-0 stall
+// attribution (reference stall_inspector.h:74-80).
+//
 // Build: g++ -O2 -shared -fPIC -std=c++17 -pthread coordinator.cc
 //            -o libhvdtpu_coord.so
 //
 // C API (ctypes):
 //   void* hvd_coord_create(int size, const char* bind_addr, int port,
 //                          long long fusion_threshold, int elastic,
-//                          int allow_ephemeral);     // NULL on failure
+//                          int allow_ephemeral, int cache_capacity,
+//                          double stall_warn_s, double stall_shutdown_s);
 //   int   hvd_coord_port(void*);
 //   void  hvd_coord_set_fusion(void*, long long);
 //   void  hvd_coord_stats(void*, long long* rounds, long long* bytes);
+//   void  hvd_coord_cache_stats(void*, long long* fast_rounds,
+//                               long long* full_rounds);
+//   int   hvd_coord_stall_report(void*, char* buf, int cap);
+//   void  hvd_coord_counts(void*, int* seen, int* departed);
 //   void  hvd_coord_stop(void*);
 
 #include <arpa/inet.h>
@@ -32,7 +45,9 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <set>
@@ -67,6 +82,7 @@ struct Request {
   double prescale = 1.0;
   double postscale = 1.0;
   int32_t psid = 0;
+  int32_t group_id = -1;
   std::vector<int64_t> shape;
   std::string name;
   std::string op;
@@ -87,6 +103,7 @@ struct Response {
   std::string op = "Sum";
   std::vector<std::vector<int64_t>> shapes;
   std::vector<int32_t> psr;
+  std::vector<int32_t> cache_bits;
 };
 
 class Reader {
@@ -128,8 +145,8 @@ class Writer {
 };
 
 bool parse_request(const uint8_t* d, size_t n, Request* r) {
-  // head "<iiiiiddiiHHH" = 50 bytes
-  if (n < 50) return false;
+  // head "<iiiiiddiiiHHH" = 54 bytes
+  if (n < 54) return false;
   Reader rd(d, n);
   r->rank = rd.get<int32_t>();
   r->type = rd.get<int32_t>();
@@ -139,6 +156,7 @@ bool parse_request(const uint8_t* d, size_t n, Request* r) {
   r->prescale = rd.get<double>();
   r->postscale = rd.get<double>();
   r->psid = rd.get<int32_t>();
+  r->group_id = rd.get<int32_t>();
   int32_t ndim = rd.get<int32_t>();
   uint16_t name_len = rd.get<uint16_t>();
   uint16_t op_len = rd.get<uint16_t>();
@@ -169,6 +187,7 @@ std::vector<uint8_t> serialize_response(const Response& r) {
   w.put<uint16_t>(uint16_t(r.op.size()));
   w.put<uint16_t>(uint16_t(r.shapes.size()));
   w.put<uint16_t>(uint16_t(r.psr.size()));
+  w.put<uint16_t>(uint16_t(r.cache_bits.size()));
   for (const auto& n : r.names) {
     w.put<uint16_t>(uint16_t(n.size()));
     w.str(n);
@@ -181,6 +200,7 @@ std::vector<uint8_t> serialize_response(const Response& r) {
     for (int64_t d : sh) w.put<int64_t>(d);
   }
   for (int32_t p : r.psr) w.put<int32_t>(p);
+  for (int32_t b : r.cache_bits) w.put<int32_t>(b);
   return std::move(w.data());
 }
 
@@ -194,6 +214,38 @@ std::vector<uint8_t> pack_response_list(const std::vector<Response>& rs) {
     w.data().insert(w.data().end(), b.begin(), b.end());
   }
   return std::move(w.data());
+}
+
+std::vector<uint8_t> pack_bits(const std::vector<int32_t>& bits) {
+  Writer w;
+  w.put<uint32_t>(uint32_t(bits.size()));
+  for (int32_t b : bits) w.put<uint32_t>(uint32_t(b));
+  return std::move(w.data());
+}
+
+std::vector<uint8_t> pack_bit_batches(
+    const std::vector<std::vector<int32_t>>& batches) {
+  Writer w;
+  w.put<uint32_t>(uint32_t(batches.size()));
+  for (const auto& batch : batches) {
+    w.put<uint32_t>(uint32_t(batch.size()));
+    for (int32_t b : batch) w.put<uint32_t>(uint32_t(b));
+  }
+  return std::move(w.data());
+}
+
+bool unpack_bits(const uint8_t* d, size_t n, std::vector<int32_t>* out) {
+  if (n < 4) return false;
+  uint32_t count;
+  std::memcpy(&count, d, 4);
+  if (n < 4 + size_t(count) * 4) return false;
+  out->resize(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    uint32_t v;
+    std::memcpy(&v, d + 4 + i * 4, 4);
+    (*out)[i] = int32_t(v);
+  }
+  return true;
 }
 
 // ---------------------------------------------------------------------
@@ -230,9 +282,11 @@ bool recv_exact(int fd, uint8_t* d, size_t n) {
   return true;
 }
 
-bool recv_frame(int fd, std::vector<uint8_t>* payload) {
+bool recv_frame(int fd, char magic[2], std::vector<uint8_t>* payload) {
   uint8_t head[6];
   if (!recv_exact(fd, head, 6)) return false;
+  magic[0] = char(head[0]);
+  magic[1] = char(head[1]);
   uint32_t len;
   std::memcpy(&len, head + 2, 4);
   if (len > (256u << 20)) return false;  // sanity bound
@@ -245,6 +299,9 @@ bool recv_frame(int fd, std::vector<uint8_t>* payload) {
 // ---------------------------------------------------------------------
 const std::set<int32_t> kFusable = {RESP_ALLREDUCE, RESP_ADASUM,
                                     RESP_ALLGATHER, RESP_REDUCESCATTER};
+const std::set<int32_t> kCacheable = {RESP_ALLREDUCE, RESP_ADASUM,
+                                      RESP_ALLGATHER, RESP_BROADCAST,
+                                      RESP_ALLTOALL, RESP_REDUCESCATTER};
 
 Response construct_response(const std::string& name,
                             const std::vector<Request>& msgs, int size) {
@@ -313,14 +370,200 @@ Response construct_response(const std::string& name,
   return r;
 }
 
+// Request signature: everything that must match for a cached response
+// to remain valid (mirrors response_cache.py request_signature).
+struct Sig {
+  std::vector<int64_t> shape;
+  int32_t dtype = 7;
+  int32_t root = -1;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t psid = 0;
+  std::string op;
+  int32_t rtype = 0;
+  std::vector<int32_t> psr;
+};
+
+Sig make_sig(const Request& r) {
+  Sig s;
+  s.shape = r.shape;
+  s.dtype = r.dtype;
+  s.root = r.root;
+  s.prescale = r.prescale;
+  s.postscale = r.postscale;
+  s.psid = r.psid;
+  s.op = r.op;
+  s.rtype = r.type;
+  s.psr = r.psr;
+  return s;
+}
+
+Request sig_to_request(const Sig& s, int rank, const std::string& name,
+                       int64_t first_dim /* -1 = keep */) {
+  Request r;
+  r.rank = rank;
+  r.type = s.rtype;
+  r.name = name;
+  r.shape = s.shape;
+  if (first_dim >= 0 && !r.shape.empty()) r.shape[0] = first_dim;
+  r.dtype = s.dtype;
+  r.root = s.root;
+  r.prescale = s.prescale;
+  r.postscale = s.postscale;
+  r.psid = s.psid;
+  r.op = s.op;
+  r.psr = s.psr;
+  return r;
+}
+
+// Coordinator-side response cache with authoritative, monotonically
+// increasing bit assignment (see response_cache.py CoordinatorCache).
+class CoordCache {
+ public:
+  struct Entry {
+    int32_t bit;
+    Response resp;  // per-tensor
+    Sig sig;
+    int32_t gid;
+  };
+  struct Tomb {
+    std::string name;
+    Sig sig;
+    std::vector<int64_t> sizes;
+    int32_t gid;
+  };
+
+  explicit CoordCache(int capacity) : capacity_(capacity) {}
+
+  bool enabled() const { return capacity_ > 0; }
+  bool has(const std::string& name) const { return entries_.count(name); }
+  Entry* get(const std::string& name) {
+    auto it = entries_.find(name);
+    return it == entries_.end() ? nullptr : &it->second;
+  }
+
+  // Returns 0 = unknown, 1 = live, 2 = tombstone.
+  int resolve_bit(int32_t bit, std::string* name, Sig* sig,
+                  std::vector<int64_t>* sizes, int32_t* gid) {
+    auto it = bit_names_.find(bit);
+    if (it != bit_names_.end()) {
+      Entry& e = entries_[it->second];
+      *name = it->second;
+      *sig = e.sig;
+      *sizes = e.resp.sizes;
+      *gid = e.gid;
+      return 1;
+    }
+    auto tit = tombstones_.find(bit);
+    if (tit != tombstones_.end()) {
+      *name = tit->second.name;
+      *sig = tit->second.sig;
+      *sizes = tit->second.sizes;
+      *gid = tit->second.gid;
+      return 2;
+    }
+    return 0;
+  }
+
+  int32_t insert(const std::string& name, const Response& resp,
+                 const Sig& sig, int32_t gid,
+                 const std::set<std::string>& pending,
+                 std::vector<int32_t>* evicted) {
+    auto it = entries_.find(name);
+    if (it != entries_.end()) {
+      tombstone(it->second.bit, name, it->second.sig,
+                it->second.resp.sizes, it->second.gid);
+      bit_names_.erase(it->second.bit);
+      evicted->push_back(it->second.bit);
+      remove_order(name);
+      entries_.erase(it);
+    }
+    while (int(entries_.size()) >= capacity_ && capacity_ > 0) {
+      std::string victim;
+      for (const auto& cand : order_) {
+        if (!pending.count(cand)) {
+          victim = cand;
+          break;
+        }
+      }
+      if (victim.empty()) break;  // everything in flight; overgrow
+      Entry& e = entries_[victim];
+      tombstone(e.bit, victim, e.sig, e.resp.sizes, e.gid);
+      bit_names_.erase(e.bit);
+      evicted->push_back(e.bit);
+      entries_.erase(victim);
+      remove_order(victim);
+    }
+    int32_t bit = next_bit_++;
+    entries_[name] = Entry{bit, resp, sig, gid};
+    order_.push_back(name);
+    bit_names_[bit] = name;
+    return bit;
+  }
+
+  // Evict by name (full request arrived for a cached tensor); returns
+  // the freed bit or -1.
+  int32_t evict_name(const std::string& name) {
+    auto it = entries_.find(name);
+    if (it == entries_.end()) return -1;
+    int32_t bit = it->second.bit;
+    tombstone(bit, name, it->second.sig, it->second.resp.sizes,
+              it->second.gid);
+    bit_names_.erase(bit);
+    entries_.erase(it);
+    remove_order(name);
+    return bit;
+  }
+
+  void clear_tombstones_for(const std::string& name) {
+    for (auto it = tombstones_.begin(); it != tombstones_.end();) {
+      if (it->second.name == name)
+        it = tombstones_.erase(it);
+      else
+        ++it;
+    }
+  }
+
+ private:
+  void tombstone(int32_t bit, const std::string& name, const Sig& sig,
+                 const std::vector<int64_t>& sizes, int32_t gid) {
+    tombstones_[bit] = Tomb{name, sig, sizes, gid};
+    tomb_order_.push_back(bit);
+    while (tomb_order_.size() > 65536) {
+      tombstones_.erase(tomb_order_.front());
+      tomb_order_.pop_front();
+    }
+  }
+  void remove_order(const std::string& name) {
+    for (auto it = order_.begin(); it != order_.end(); ++it) {
+      if (*it == name) {
+        order_.erase(it);
+        return;
+      }
+    }
+  }
+
+  int capacity_;
+  std::map<std::string, Entry> entries_;
+  std::deque<std::string> order_;  // FIFO insertion order
+  std::map<int32_t, std::string> bit_names_;
+  std::map<int32_t, Tomb> tombstones_;
+  std::deque<int32_t> tomb_order_;
+  int32_t next_bit_ = 0;
+};
+
 class Coordinator {
  public:
   Coordinator(int size, const std::string& bind_addr, int port,
               int64_t fusion_threshold, bool elastic,
-              bool allow_ephemeral)
+              bool allow_ephemeral, int cache_capacity,
+              double stall_warn_s, double stall_shutdown_s)
       : size_(size),
         fusion_threshold_(fusion_threshold),
-        elastic_(elastic) {
+        elastic_(elastic),
+        cache_(cache_capacity),
+        stall_warn_s_(stall_warn_s),
+        stall_shutdown_s_(stall_shutdown_s) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return;
     int one = 1;
@@ -351,6 +594,8 @@ class Coordinator {
     ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&bound), &blen);
     port_ = ntohs(bound.sin_port);
     accept_thread_ = std::thread([this] { AcceptLoop(); });
+    if (stall_warn_s_ > 0)
+      stall_thread_ = std::thread([this] { StallLoop(); });
   }
 
   bool valid() const { return listen_fd_ >= 0; }
@@ -361,6 +606,47 @@ class Coordinator {
   void stats(int64_t* rounds, int64_t* bytes) {
     *rounds = rounds_.load();
     *bytes = bytes_.load();
+  }
+
+  void cache_stats(int64_t* fast, int64_t* full) {
+    *fast = fast_rounds_.load();
+    *full = full_rounds_.load();
+  }
+
+  // Human-readable stall attribution, one line per stalled tensor.
+  std::string StallReport() {
+    std::string out;
+    auto now = std::chrono::steady_clock::now();
+    std::lock_guard<std::mutex> g(mu_);
+    for (const auto& kv : table_) {
+      if (kv.second.empty()) continue;
+      auto ts = first_seen_.find(kv.first);
+      if (ts == first_seen_.end()) continue;
+      double age =
+          std::chrono::duration<double>(now - ts->second).count();
+      if (age < stall_warn_s_) continue;
+      std::set<int32_t> submitted;
+      for (const auto& m : kv.second) submitted.insert(m.rank);
+      std::vector<int32_t> members;
+      if (!kv.second[0].psr.empty())
+        members = kv.second[0].psr;
+      else
+        for (int r = 0; r < size_; ++r) members.push_back(r);
+      std::string sub, miss;
+      for (int32_t r : submitted) sub += std::to_string(r) + ",";
+      for (int32_t r : members)
+        if (!submitted.count(r) && !joined_.count(r))
+          miss += std::to_string(r) + ",";
+      if (!sub.empty()) sub.pop_back();
+      if (!miss.empty()) miss.pop_back();
+      char line[512];
+      std::snprintf(line, sizeof(line),
+                    "STALL: tensor %s - ranks [%s] submitted, ranks "
+                    "[%s] have not, for %.0fs\n",
+                    kv.first.c_str(), sub.c_str(), miss.c_str(), age);
+      out += line;
+    }
+    return out;
   }
 
   void Stop() {
@@ -378,7 +664,9 @@ class Coordinator {
       }
       conns_.clear();
     }
+    stall_cv_.notify_all();
     if (accept_thread_.joinable()) accept_thread_.join();
+    if (stall_thread_.joinable()) stall_thread_.join();
     for (auto& t : rank_threads_)
       if (t.joinable()) t.join();
   }
@@ -397,8 +685,9 @@ class Coordinator {
       int one = 1;
       ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       // First frame: rank id.
+      char magic[2];
       std::vector<uint8_t> payload;
-      if (!recv_frame(conn, &payload) || payload.size() < 4) {
+      if (!recv_frame(conn, magic, &payload) || payload.size() < 4) {
         ::close(conn);
         continue;
       }
@@ -419,9 +708,16 @@ class Coordinator {
 
   void RankLoop(int rank, int conn) {
     bool clean = false;
+    char magic[2];
     std::vector<uint8_t> payload;
     while (!stop_.load()) {
-      if (!recv_frame(conn, &payload)) break;
+      if (!recv_frame(conn, magic, &payload)) break;
+      if (magic[0] == 'C' && magic[1] == 'H') {
+        std::vector<int32_t> bits;
+        if (!unpack_bits(payload.data(), payload.size(), &bits)) break;
+        HandleCacheHits(rank, bits);
+        continue;
+      }
       if (payload.size() < 5) break;
       uint8_t shutdown_flag = payload[0];
       if (shutdown_flag) {
@@ -486,19 +782,22 @@ class Coordinator {
   }
 
   // Tensors waiting only on joined (departed) ranks became complete.
-  void ScanComplete(std::vector<Response>* ready) {
+  void ScanComplete(
+      std::vector<std::pair<std::string, std::vector<Request>>>* ready) {
     std::vector<std::string> done;
     for (auto& kv : table_) {
       if (kv.second.empty()) continue;
       const Request& first = kv.second[0];
       int required = RequiredFor(first);
       if (int(kv.second.size()) + JoinedCountFor(first) >= required) {
-        ready->push_back(
-            construct_response(kv.first, kv.second, size_));
+        ready->emplace_back(kv.first, kv.second);
         done.push_back(kv.first);
       }
     }
-    for (const auto& n : done) table_.erase(n);
+    for (const auto& n : done) {
+      table_.erase(n);
+      first_seen_.erase(n);
+    }
   }
 
   int64_t ResponseBytes(const Response& r) {
@@ -519,11 +818,53 @@ class Coordinator {
            a.op == b.op;
   }
 
+  static void MergeInto(Response* base, const Response& cand) {
+    base->names.insert(base->names.end(), cand.names.begin(),
+                       cand.names.end());
+    base->sizes.insert(base->sizes.end(), cand.sizes.begin(),
+                       cand.sizes.end());
+    base->shapes.insert(base->shapes.end(), cand.shapes.begin(),
+                        cand.shapes.end());
+  }
+
+  // Group-atomic pre-merge: members of one grouped submission become a
+  // single response BEFORE threshold-bounded fusion, so a group is
+  // never split across compiled programs (fusion.py _premerge_groups;
+  // reference controller.cc:199-223).
+  std::vector<Response> PremergeGroups(std::vector<Response> in) {
+    std::vector<Response> merged;
+    std::map<std::string, size_t> index;  // group fuse-key -> position
+    for (auto& resp : in) {
+      int32_t gid = -1;
+      if (!resp.names.empty()) {
+        auto it = group_ids_.find(resp.names[0]);
+        if (it != group_ids_.end()) gid = it->second;
+      }
+      if (gid < 0 || !kFusable.count(resp.type)) {
+        merged.push_back(std::move(resp));
+        continue;
+      }
+      char key[160];
+      std::snprintf(key, sizeof(key), "%d|%d|%d|%d|%.17g|%.17g|%s", gid,
+                    resp.type, resp.dtype, resp.psid, resp.prescale,
+                    resp.postscale, resp.op.c_str());
+      auto it = index.find(key);
+      if (it == index.end()) {
+        index[key] = merged.size();
+        merged.push_back(std::move(resp));
+      } else {
+        MergeInto(&merged[it->second], resp);
+      }
+    }
+    return merged;
+  }
+
   // Greedy fusion with look-ahead skip (fusion.py / reference
   // controller.cc:777-914).
   std::vector<Response> Fuse(std::vector<Response> queue) {
     std::vector<Response> out;
     int64_t threshold = fusion_threshold_.load();
+    queue = PremergeGroups(std::move(queue));
     while (!queue.empty()) {
       Response base = std::move(queue.front());
       queue.erase(queue.begin());
@@ -538,12 +879,7 @@ class Coordinator {
         if (CanFuse(base, cand)) {
           int64_t cb = ResponseBytes(cand);
           if (acc + cb <= threshold) {
-            base.names.insert(base.names.end(), cand.names.begin(),
-                              cand.names.end());
-            base.sizes.insert(base.sizes.end(), cand.sizes.begin(),
-                              cand.sizes.end());
-            base.shapes.insert(base.shapes.end(), cand.shapes.begin(),
-                               cand.shapes.end());
+            MergeInto(&base, cand);
             acc += cb;
             queue.erase(queue.begin() + i);
             continue;
@@ -558,10 +894,15 @@ class Coordinator {
   }
 
   void BroadcastLocked(const std::vector<Response>& responses) {
-    auto payload = pack_response_list(responses);
+    BroadcastFrameLocked("RS", pack_response_list(responses));
+  }
+
+  void BroadcastFrameLocked(const char magic[2],
+                            const std::vector<uint8_t>& payload) {
     std::vector<int> dead;
     for (auto& kv : conns_) {
-      if (!send_frame(kv.second, "RS", payload)) dead.push_back(kv.first);
+      if (!send_frame(kv.second, magic, payload))
+        dead.push_back(kv.first);
     }
     for (int r : dead) {
       ::close(conns_[r]);
@@ -569,25 +910,78 @@ class Coordinator {
     }
   }
 
+  void FlushEvictionsLocked() {
+    if (pending_evictions_.empty()) return;
+    BroadcastFrameLocked("EV", pack_bits(pending_evictions_));
+    pending_evictions_.clear();
+  }
+
   void HandleRequests(int rank, const std::vector<Request>& reqs) {
     std::lock_guard<std::mutex> g(mu_);
-    if (broken_) {
-      std::vector<Response> errs;
-      for (const auto& req : reqs) {
+    std::vector<std::pair<Request, bool>> items;
+    items.reserve(reqs.size());
+    for (const auto& r : reqs) items.emplace_back(r, false);
+    Process(rank, items);
+  }
+
+  void HandleCacheHits(int rank, const std::vector<int32_t>& bits) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<std::pair<Request, bool>> items;
+    for (int32_t bit : bits) {
+      std::string name;
+      Sig sig;
+      std::vector<int64_t> sizes;
+      int32_t gid;
+      int state = cache_.resolve_bit(bit, &name, &sig, &sizes, &gid);
+      if (state == 0) {
+        std::fprintf(stderr,
+                     "[hvd-coord] unresolvable cache bit %d from rank "
+                     "%d; protocol desync\n",
+                     bit, rank);
         Response r;
         r.type = RESP_ERROR;
-        r.names = {req.name};
+        r.names = {"__cache_bit_" + std::to_string(bit)};
+        r.error = "response-cache protocol desync";
+        BroadcastLocked({r});
+        continue;
+      }
+      int64_t first_dim = -1;
+      if (sig.rtype == REQ_ALLGATHER && !sizes.empty() && rank >= 0 &&
+          rank < int(sizes.size()))
+        first_dim = sizes[rank];
+      Request req = sig_to_request(sig, rank, name, first_dim);
+      req.group_id = gid;
+      // A tombstoned bit still counts, but forces the full path.
+      items.emplace_back(std::move(req), state == 1);
+    }
+    if (!items.empty()) Process(rank, items);
+  }
+
+  void Process(int rank, const std::vector<std::pair<Request, bool>>& items) {
+    if (broken_) {
+      std::vector<Response> errs;
+      for (const auto& it : items) {
+        Response r;
+        r.type = RESP_ERROR;
+        r.names = {it.first.name};
         r.error = "membership changed; collective cannot complete";
         errs.push_back(std::move(r));
       }
       if (!errs.empty()) BroadcastLocked(errs);
       return;
     }
-    std::vector<Response> ready;
-    for (const auto& req : reqs) {
+    // (name, msgs) for completed negotiations; direct responses for
+    // join/barrier control flow.
+    std::vector<std::pair<std::string, std::vector<Request>>> completed;
+    std::vector<std::pair<size_t, Response>> direct;  // order anchor
+    size_t order = 0;
+    for (const auto& item : items) {
+      const Request& req = item.first;
+      bool from_cache = item.second;
       int64_t n = 1;
       for (int64_t d : req.shape) n *= d;
       elem_cache_[req.name] = n;
+      group_ids_[req.name] = req.group_id;
       if (req.type == REQ_JOIN) {
         joined_.insert(rank);
         last_joined_ = rank;
@@ -596,10 +990,12 @@ class Coordinator {
           r.type = RESP_JOIN;
           r.names = {"join"};
           r.last_joined = last_joined_;
-          ready.push_back(std::move(r));
+          direct.emplace_back(order++, std::move(r));
           joined_.clear();
         } else {
-          ScanComplete(&ready);
+          size_t before = completed.size();
+          ScanComplete(&completed);
+          order += completed.size() - before;
         }
         continue;
       }
@@ -614,25 +1010,171 @@ class Coordinator {
           r.names = {req.name};
           r.psid = req.psid;
           r.psr = req.psr;
-          ready.push_back(std::move(r));
+          direct.emplace_back(order++, std::move(r));
         }
         continue;
       }
+      if (!from_cache) {
+        bit_only_[req.name] = false;
+        if (cache_.has(req.name)) {
+          // Signature changed on some rank (or worker-side eviction):
+          // renegotiate so a stale response can never serve.
+          int32_t bit = cache_.evict_name(req.name);
+          if (bit >= 0) pending_evictions_.push_back(bit);
+        }
+      } else if (!bit_only_.count(req.name)) {
+        bit_only_[req.name] = true;
+      }
       int required = RequiredFor(req);
+      if (!first_seen_.count(req.name))
+        first_seen_[req.name] = std::chrono::steady_clock::now();
       auto& msgs = table_[req.name];
       msgs.push_back(req);
       if (int(msgs.size()) + JoinedCountFor(req) >= required) {
-        ready.push_back(construct_response(req.name, msgs, size_));
+        completed.emplace_back(req.name, std::move(msgs));
         table_.erase(req.name);
+        first_seen_.erase(req.name);
+        ++order;
       }
     }
-    if (ready.empty()) return;
-    auto fused = Fuse(std::move(ready));
-    BroadcastLocked(fused);
+    if (completed.empty() && direct.empty()) {
+      FlushEvictionsLocked();
+      return;
+    }
+
+    // Partition: pure-bit rounds ride the compact CB frame.
+    std::vector<Response> hit_responses;
+    std::vector<Response> full_responses;
+    std::map<std::string, Sig> sig_by_name;
+    for (auto& kv : completed) {
+      const std::string& name = kv.first;
+      bool bit_only = false;
+      auto bo = bit_only_.find(name);
+      if (bo != bit_only_.end()) {
+        bit_only = bo->second;
+        bit_only_.erase(bo);
+      }
+      CoordCache::Entry* ent = cache_.get(name);
+      if (bit_only && ent != nullptr) {
+        hit_responses.push_back(ent->resp);
+        continue;
+      }
+      Response resp = construct_response(name, kv.second, size_);
+      sig_by_name[name] = make_sig(kv.second[0]);
+      full_responses.push_back(std::move(resp));
+      cache_.clear_tombstones_for(name);
+    }
+    for (auto& d : direct) full_responses.push_back(std::move(d.second));
+
     int64_t nbytes = 0;
-    for (const auto& r : fused) nbytes += ResponseBytes(r);
+    if (!hit_responses.empty()) {
+      auto fused_hits = Fuse(hit_responses);
+      std::vector<std::vector<int32_t>> batches;
+      for (const auto& fr : fused_hits) {
+        std::vector<int32_t> batch;
+        for (const auto& n : fr.names) {
+          CoordCache::Entry* e = cache_.get(n);
+          batch.push_back(e ? e->bit : -1);
+        }
+        batches.push_back(std::move(batch));
+        nbytes += ResponseBytes(fr);
+      }
+      BroadcastFrameLocked("CB", pack_bit_batches(batches));
+      fast_rounds_.fetch_add(1);
+    }
+    if (!full_responses.empty()) {
+      auto fused = Fuse(std::move(full_responses));
+      if (cache_.enabled()) AssignCacheBits(&fused, sig_by_name);
+      FlushEvictionsLocked();
+      BroadcastLocked(fused);
+      full_rounds_.fetch_add(1);
+      for (const auto& r : fused) nbytes += ResponseBytes(r);
+    } else {
+      FlushEvictionsLocked();
+    }
     rounds_.fetch_add(1);
     bytes_.fetch_add(nbytes);
+  }
+
+  // Slice a fused response into per-tensor responses (mirrors
+  // response_cache.py split_response) and seed the cache, stamping the
+  // assigned bits onto the wire.
+  void AssignCacheBits(std::vector<Response>* fused,
+                       const std::map<std::string, Sig>& sig_by_name) {
+    std::set<std::string> pending;
+    for (const auto& kv : table_) pending.insert(kv.first);
+    for (auto& resp : *fused) {
+      if (!kCacheable.count(resp.type) || !resp.error.empty()) continue;
+      size_t per_sizes = 0;
+      if (resp.type == RESP_ALLGATHER && size_ > 0 &&
+          resp.sizes.size() == size_t(size_) * resp.names.size())
+        per_sizes = size_t(size_);
+      resp.cache_bits.clear();
+      for (size_t i = 0; i < resp.names.size(); ++i) {
+        auto sit = sig_by_name.find(resp.names[i]);
+        if (sit == sig_by_name.end()) {
+          resp.cache_bits.push_back(-1);
+          continue;
+        }
+        Response part;
+        part.type = resp.type;
+        part.dtype = resp.dtype;
+        part.prescale = resp.prescale;
+        part.postscale = resp.postscale;
+        part.psid = resp.psid;
+        part.root = resp.root;
+        part.op = resp.op;
+        part.names = {resp.names[i]};
+        if (per_sizes)
+          part.sizes.assign(resp.sizes.begin() + i * per_sizes,
+                            resp.sizes.begin() + (i + 1) * per_sizes);
+        else
+          part.sizes = resp.sizes;
+        if (i < resp.shapes.size()) part.shapes = {resp.shapes[i]};
+        part.psr = resp.psr;
+        auto git = group_ids_.find(resp.names[i]);
+        int32_t gid = git == group_ids_.end() ? -1 : git->second;
+        int32_t bit = cache_.insert(resp.names[i], part, sit->second,
+                                    gid, pending, &pending_evictions_);
+        resp.cache_bits.push_back(bit);
+      }
+    }
+  }
+
+  void StallLoop() {
+    double interval = stall_warn_s_ / 2.0;
+    if (interval > 10.0) interval = 10.0;
+    if (interval < 0.25) interval = 0.25;
+    std::unique_lock<std::mutex> lk(stall_mu_);
+    while (!stop_.load()) {
+      stall_cv_.wait_for(lk, std::chrono::duration<double>(interval));
+      if (stop_.load()) return;
+      auto report = StallReport();
+      if (!report.empty()) std::fprintf(stderr, "%s", report.c_str());
+      if (stall_shutdown_s_ <= 0) continue;
+      // Fail collectives stalled past the shutdown threshold.
+      auto now = std::chrono::steady_clock::now();
+      std::lock_guard<std::mutex> g(mu_);
+      std::vector<std::string> doomed;
+      for (const auto& kv : table_) {
+        auto ts = first_seen_.find(kv.first);
+        if (ts == first_seen_.end()) continue;
+        double age =
+            std::chrono::duration<double>(now - ts->second).count();
+        if (age >= stall_shutdown_s_) doomed.push_back(kv.first);
+      }
+      for (const auto& name : doomed) {
+        table_.erase(name);
+        first_seen_.erase(name);
+        bit_only_.erase(name);
+        Response r;
+        r.type = RESP_ERROR;
+        r.names = {name};
+        r.error = "collective " + name +
+                  " stalled past the shutdown threshold";
+        BroadcastLocked({r});
+      }
+    }
   }
 
   void OnRankLost(int rank, bool clean) {
@@ -665,16 +1207,22 @@ class Coordinator {
     }
     table_.clear();
     barriers_.clear();
+    first_seen_.clear();
+    bit_only_.clear();
     if (!errs.empty()) BroadcastLocked(errs);
   }
 
   int size_;
   std::atomic<int64_t> fusion_threshold_;
   bool elastic_;
+  CoordCache cache_;
+  double stall_warn_s_;
+  double stall_shutdown_s_;
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> stop_{false};
   std::thread accept_thread_;
+  std::thread stall_thread_;
   std::vector<std::thread> rank_threads_;
 
   std::mutex mu_;
@@ -682,15 +1230,23 @@ class Coordinator {
   std::map<std::string, std::vector<Request>> table_;
   std::map<std::string, std::set<int>> barriers_;
   std::map<std::string, int64_t> elem_cache_;
+  std::map<std::string, int32_t> group_ids_;
+  std::map<std::string, bool> bit_only_;
+  std::map<std::string, std::chrono::steady_clock::time_point> first_seen_;
+  std::vector<int32_t> pending_evictions_;
   std::set<int> joined_;
   int last_joined_ = -1;
   bool broken_ = false;
   std::mutex departed_mu_;
   std::condition_variable departed_cv_;
+  std::mutex stall_mu_;
+  std::condition_variable stall_cv_;
   int seen_ = 0;
   int departed_ = 0;
   std::atomic<int64_t> rounds_{0};
   std::atomic<int64_t> bytes_{0};
+  std::atomic<int64_t> fast_rounds_{0};
+  std::atomic<int64_t> full_rounds_{0};
 };
 
 }  // namespace
@@ -699,10 +1255,12 @@ extern "C" {
 
 void* hvd_coord_create(int size, const char* bind_addr, int port,
                        long long fusion_threshold, int elastic,
-                       int allow_ephemeral) {
+                       int allow_ephemeral, int cache_capacity,
+                       double stall_warn_s, double stall_shutdown_s) {
   auto* c = new Coordinator(size, bind_addr ? bind_addr : "", port,
                             fusion_threshold, elastic != 0,
-                            allow_ephemeral != 0);
+                            allow_ephemeral != 0, cache_capacity,
+                            stall_warn_s, stall_shutdown_s);
   if (!c->valid()) {
     delete c;
     return nullptr;
@@ -723,6 +1281,24 @@ void hvd_coord_stats(void* h, long long* rounds, long long* bytes) {
   static_cast<Coordinator*>(h)->stats(&r, &b);
   *rounds = r;
   *bytes = b;
+}
+
+void hvd_coord_cache_stats(void* h, long long* fast_rounds,
+                           long long* full_rounds) {
+  int64_t f, n;
+  static_cast<Coordinator*>(h)->cache_stats(&f, &n);
+  *fast_rounds = f;
+  *full_rounds = n;
+}
+
+int hvd_coord_stall_report(void* h, char* buf, int cap) {
+  std::string s = static_cast<Coordinator*>(h)->StallReport();
+  int n = int(s.size());
+  if (n > cap - 1) n = cap - 1;
+  if (n < 0) n = 0;
+  std::memcpy(buf, s.data(), size_t(n));
+  buf[n] = '\0';
+  return n;
 }
 
 void hvd_coord_counts(void* h, int* seen, int* departed) {

@@ -73,14 +73,56 @@ class XlaMeshBackend(Backend):
             raise RuntimeError(
                 f"jax sees {len(by_proc)} processes but HOROVOD_SIZE="
                 f"{self.size}; was jax.distributed initialized?")
-        # One representative device per process carries the eager data
-        # plane; in-graph training uses the full device set.  Rank order
-        # must match HOROVOD_RANK order == jax process index order (the
-        # launcher assigns both from the same slot plan).
+        # One representative device per process carries the flat eager
+        # data plane; in-graph training uses the full device set.  Rank
+        # order must match HOROVOD_RANK order == jax process index order
+        # (the launcher assigns both from the same slot plan).
         self._reps = [sorted(v, key=lambda d: d.id)[0]
                       for _, v in sorted(by_proc.items())]
         self.mesh = Mesh(np.array(self._reps), ("world",))
         self.rep_device = self._reps[jax.process_index()]
+        self._init_hierarchy(by_proc, state.rank_info)
+
+    def _init_hierarchy(self, by_proc, ri):
+        """Build the 2-level (cross, local) mesh behind
+        HOROVOD_HIERARCHICAL_ALLREDUCE (reference:
+        NCCLHierarchicalAllreduce, ops/nccl_operations.cc:188-360 —
+        intra-node reduce-scatter, cross-node allreduce, intra-node
+        allgather; here local=ICI, cross=DCN).
+
+        Two topologies map onto the local axis:
+          * ``device``: each process drives several chips (one process
+            per TPU-VM host) — the fused buffer shards across the local
+            chips, so the cross-host leg runs per-chip in parallel and
+            no chip idles (the eager path uses ALL local devices);
+          * ``proc``: several ranks share a host (CPU rigs, one chip
+            per process) — classic Horovod local ranks.
+        The knob is consulted per call, so the autotuner can flip it at
+        runtime (parameter sync, reference controller.cc:39-53).
+        """
+        self._hier = None
+        self._hier_kind = None
+        self.local_devices = sorted(by_proc[jax.process_index()],
+                                    key=lambda d: d.id)
+        ndev = min(len(v) for v in by_proc.values())
+        if ndev > 1:
+            grid = np.array([sorted(v, key=lambda d: d.id)[:ndev]
+                             for _, v in sorted(by_proc.items())])
+            self._hier = Mesh(grid, ("cross", "local"))
+            self._hier_kind = "device"
+            self._hier_nlocal = ndev
+        elif (ri.local_size > 1 and
+                ri.size == ri.cross_size * ri.local_size and
+                ri.rank == ri.cross_rank * ri.local_size + ri.local_rank):
+            grid = np.array(self._reps).reshape(
+                ri.cross_size, ri.local_size)
+            self._hier = Mesh(grid, ("cross", "local"))
+            self._hier_kind = "proc"
+            self._hier_nlocal = ri.local_size
+
+    def hierarchical_active(self, ps_ranks=()) -> bool:
+        return (self.state.knobs.hierarchical_allreduce and
+                self._hier is not None and not ps_ranks)
 
     # ------------------------------------------------------------------
     # process-set sub-meshes
@@ -151,6 +193,10 @@ class XlaMeshBackend(Backend):
 
     def allreduce(self, arrays, reduce_op, prescale, postscale,
                   ps_ranks=()):
+        if self.hierarchical_active(ps_ranks) and \
+                reduce_op in ("Sum", "Average"):
+            return self._hierarchical_allreduce(
+                arrays, reduce_op, prescale, postscale)
         mesh, gsize, _ = self._group(tuple(ps_ranks))
         globals_, meta = [], []
         for x in arrays:
@@ -163,6 +209,118 @@ class XlaMeshBackend(Backend):
         return [self._from_replicated(o, wj)
                 for o, wj in zip(outs, meta)]
 
+    # ------------------------------------------------------------------
+    # hierarchical allreduce: local reduce-scatter → cross allreduce →
+    # local allgather (reference ops/nccl_operations.cc:188-360)
+    # ------------------------------------------------------------------
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _hier_proc_fn(mesh, shapes, reduce_op: str, prescale: float,
+                      postscale: float, divisor: int):
+        """Each rank holds a full copy: reduce-scatter over the local
+        (intra-host) axis, allreduce the shards over the cross axis,
+        allgather back over local.  Input/output: flat padded buffers."""
+        def body(*xs):
+            out = []
+            for x in xs:
+                x = x[0, 0]
+                if prescale != 1.0:
+                    x = x * jnp.asarray(prescale, x.dtype)
+                y = jax.lax.psum_scatter(x, "local",
+                                         scatter_dimension=0, tiled=True)
+                y = jax.lax.psum(y, "cross")
+                y = jax.lax.all_gather(y, "local", axis=0, tiled=True)
+                scale = postscale / divisor if reduce_op == "Average" \
+                    else postscale
+                if scale != 1.0:
+                    y = y * jnp.asarray(scale, y.dtype)
+                out.append(y)
+            return tuple(out)
+        n = len(shapes)
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P("cross", "local") for _ in range(n)),
+            out_specs=tuple(P() for _ in range(n)), check_vma=False))
+
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _hier_dev_fn(mesh, shapes, reduce_op: str, prescale: float,
+                     postscale: float, divisor: int):
+        """Each process's buffer is already scattered over its local
+        chips: allreduce each shard over the cross axis (parallel
+        per-chip streams), allgather over local to rebuild the full
+        tensor.  Input: (nproc, nlocal, chunk) globals."""
+        def body(*xs):
+            out = []
+            for x in xs:
+                x = x[0, 0]
+                if prescale != 1.0:
+                    x = x * jnp.asarray(prescale, x.dtype)
+                y = jax.lax.psum(x, "cross")
+                y = jax.lax.all_gather(y, "local", axis=0, tiled=True)
+                scale = postscale / divisor if reduce_op == "Average" \
+                    else postscale
+                if scale != 1.0:
+                    y = y * jnp.asarray(scale, y.dtype)
+                out.append(y)
+            return tuple(out)
+        n = len(shapes)
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P("cross", "local") for _ in range(n)),
+            out_specs=tuple(P() for _ in range(n)), check_vma=False))
+
+    def _hierarchical_allreduce(self, arrays, reduce_op, prescale,
+                                postscale):
+        mesh = self._hier
+        nlocal = self._hier_nlocal
+        ncross = self.size if self._hier_kind == "device" else \
+            self.size // nlocal
+        divisor = self.size
+        flats, meta = [], []
+        for x in arrays:
+            was_jax = isinstance(x, jax.Array)
+            arr = jnp.asarray(x) if was_jax else jnp.asarray(np.asarray(x))
+            shape = arr.shape
+            flat = arr.reshape(-1)
+            n = flat.shape[0]
+            pad = (-n) % nlocal
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            flats.append(flat)
+            meta.append((was_jax, shape, n))
+        if self._hier_kind == "device":
+            globals_ = []
+            for flat in flats:
+                chunk = flat.shape[0] // nlocal
+                pieces = flat.reshape(nlocal, chunk)
+                shards = [jax.device_put(pieces[i][None, None],
+                                         self.local_devices[i])
+                          for i in range(nlocal)]
+                globals_.append(jax.make_array_from_single_device_arrays(
+                    (ncross, nlocal, chunk),
+                    NamedSharding(mesh, P("cross", "local")), shards))
+            fn = self._hier_dev_fn(
+                mesh, tuple(f.shape for f in flats), reduce_op,
+                float(prescale), float(postscale), divisor)
+        else:
+            globals_ = []
+            for flat in flats:
+                local = jax.device_put(flat[None, None], self.rep_device)
+                globals_.append(jax.make_array_from_single_device_arrays(
+                    (ncross, nlocal) + tuple(flat.shape),
+                    NamedSharding(mesh, P("cross", "local")), [local]))
+            fn = self._hier_proc_fn(
+                mesh, tuple(f.shape for f in flats), reduce_op,
+                float(prescale), float(postscale), divisor)
+        outs = fn(*globals_)
+        results = []
+        for o, (was_jax, shape, n) in zip(outs, meta):
+            local = o.addressable_data(0)
+            r = local[:n].reshape(shape)
+            results.append(r if was_jax else np.asarray(r))
+        return results
+
     def adasum_allreduce(self, arrays, prescale, postscale, ps_ranks=()):
         from .adasum import adasum_allreduce_global
         mesh, gsize, _ = self._group(tuple(ps_ranks))
@@ -174,11 +332,22 @@ class XlaMeshBackend(Backend):
     # ------------------------------------------------------------------
     @staticmethod
     @lru_cache(maxsize=256)
-    def _gather_fn(mesh, n: int):
+    def _gather_fn(mesh, tsizes_per_tensor: Tuple[Tuple[int, ...], ...]):
+        """Gather + per-rank unpad + concat, all inside one compiled
+        program (device-resident: no host round-trip; reference analog
+        is the fused allgather displacement math in
+        ops/collective_operations.cc).  ``tsizes_per_tensor`` is static
+        per executable — a different row layout compiles a new program,
+        same as any shape change."""
         def body(*xs):
-            return tuple(
-                jax.lax.all_gather(x[0], "world", axis=0, tiled=False)
-                for x in xs)
+            out = []
+            for x, tsizes in zip(xs, tsizes_per_tensor):
+                full = jax.lax.all_gather(x[0], "world", axis=0,
+                                          tiled=False)
+                pieces = [full[r, :tsizes[r]] for r in range(len(tsizes))]
+                out.append(jnp.concatenate(pieces, axis=0))
+            return tuple(out)
+        n = len(tsizes_per_tensor)
         return jax.jit(jax.shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("world") for _ in range(n)),
@@ -188,12 +357,13 @@ class XlaMeshBackend(Backend):
         """``sizes`` holds ``group_size`` entries per tensor, in tensor
         order (fused responses concatenate them)."""
         mesh, gsize, _ = self._group(tuple(ps_ranks))
-        per_tensor_sizes = [sizes[i * gsize:(i + 1) * gsize]
+        per_tensor_sizes = [tuple(sizes[i * gsize:(i + 1) * gsize])
                             for i in range(len(arrays))]
         globals_, meta = [], []
         for x, tsizes in zip(arrays, per_tensor_sizes):
             was_jax = isinstance(x, jax.Array)
-            arr = jnp.asarray(x)
+            arr = jnp.asarray(x) if was_jax else \
+                jnp.asarray(np.asarray(x))
             if arr.ndim == 0:
                 arr = arr[None]
             rows = arr.shape[0]
@@ -204,16 +374,11 @@ class XlaMeshBackend(Backend):
                 arr = jnp.pad(arr, pad_widths)
             g, _ = self._to_global(arr, mesh, gsize)
             globals_.append(g)
-            meta.append((was_jax, tsizes))
-        fn = self._gather_fn(mesh, len(globals_))
+            meta.append(was_jax)
+        fn = self._gather_fn(mesh, tuple(per_tensor_sizes))
         outs = fn(*globals_)
-        results = []
-        for o, (was_jax, tsizes) in zip(outs, meta):
-            full = np.asarray(o.addressable_data(0))  # (group, maxrows, …)
-            pieces = [full[r, :tsizes[r]] for r in range(gsize)]
-            cat = np.concatenate(pieces, axis=0)
-            results.append(jnp.asarray(cat) if was_jax else cat)
-        return results
+        return [self._from_replicated(o, wj)
+                for o, wj in zip(outs, meta)]
 
     # ------------------------------------------------------------------
     # broadcast
@@ -261,10 +426,51 @@ class XlaMeshBackend(Backend):
             body, mesh=mesh, in_specs=P("world"), out_specs=P("world"),
             check_vma=False))
 
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _a2a_pack_fn(send_splits: Tuple[int, ...], maxchunk: int,
+                     shape: Tuple[int, ...], dtype: str):
+        """Device-side scatter of the concatenated send buffer into the
+        padded (gsize, maxchunk, ...) exchange layout.  Runs OUTSIDE the
+        collective program: send splits differ per rank, and every
+        rank's shard_map program must stay identical (SPMD)."""
+        gsize = len(send_splits)
+
+        @jax.jit
+        def pack(x):
+            chunks = jnp.zeros((gsize, maxchunk) + x.shape[1:],
+                               dtype=x.dtype)
+            off = 0
+            for r in range(gsize):
+                c = send_splits[r]
+                if c:
+                    chunks = chunks.at[r, :c].set(
+                        jax.lax.slice_in_dim(x, off, off + c, axis=0))
+                off += c
+            return chunks
+        return pack
+
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _a2a_unpack_fn(recv_splits: Tuple[int, ...],
+                       shape: Tuple[int, ...], dtype: str):
+        gsize = len(recv_splits)
+
+        @jax.jit
+        def unpack(y):
+            pieces = [jax.lax.slice_in_dim(y[r], 0, recv_splits[r],
+                                           axis=0)
+                      for r in range(gsize) if recv_splits[r]]
+            if not pieces:
+                return y[0, :0]
+            return jnp.concatenate(pieces, axis=0)
+        return unpack
+
     def alltoall(self, array, splits, ps_ranks=()):
         mesh, gsize, my_idx = self._group(tuple(ps_ranks))
         was_jax = isinstance(array, jax.Array)
-        arr = np.asarray(array)
+        arr = jnp.asarray(array) if was_jax else \
+            jnp.asarray(np.asarray(array))
         if splits is None:
             base = arr.shape[0] // gsize
             rem = arr.shape[0] % gsize
@@ -272,26 +478,26 @@ class XlaMeshBackend(Backend):
                 [base + (1 if r < rem else 0) for r in range(gsize)],
                 dtype=np.int64)
         splits = np.asarray(splits, dtype=np.int64)
-        # Exchange the split matrix first (one fused gather).
+        # Exchange the split matrix first (small; the recv split vector
+        # is part of the public API so it lives on the host anyway —
+        # reference AlltoallGetRecvSplits, mpi_controller.cc:212-223).
         split_mat = np.asarray(self.allgather(
             [splits], sizes=[gsize] * gsize,
             ps_ranks=ps_ranks)[0]).reshape(gsize, gsize)
         recv_splits = split_mat[:, my_idx].copy()
         maxchunk = int(split_mat.max()) if split_mat.size else 0
-        rest = arr.shape[1:]
-        chunks = np.zeros((gsize, maxchunk) + rest, dtype=arr.dtype)
-        off = 0
-        for r in range(gsize):
-            c = int(splits[r])
-            chunks[r, :c] = arr[off:off + c]
-            off += c
+        pack = self._a2a_pack_fn(tuple(int(s) for s in splits), maxchunk,
+                                 tuple(arr.shape), str(arr.dtype))
+        chunks = pack(arr)
         g, _ = self._to_global(chunks, mesh, gsize)
         out = self._a2a_fn(mesh)(g)
-        mine = np.asarray(out.addressable_data(0))[0]  # (group, maxchunk,…)
-        pieces = [mine[r, :int(recv_splits[r])] for r in range(gsize)]
-        result = np.concatenate(pieces, axis=0) if pieces else mine[:0]
-        if was_jax:
-            result = jnp.asarray(result)
+        mine = out.addressable_data(0)[0]  # (group, maxchunk, ...)
+        unpack = self._a2a_unpack_fn(
+            tuple(int(s) for s in recv_splits), tuple(mine.shape),
+            str(mine.dtype))
+        result = unpack(mine)
+        if not was_jax:
+            result = np.asarray(result)
         return result, recv_splits
 
     # ------------------------------------------------------------------
@@ -320,6 +526,30 @@ class XlaMeshBackend(Backend):
             out_specs=tuple(P("world") for _ in range(n)),
             check_vma=False))
 
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _rs_pack_fn(counts: Tuple[int, ...], chunk: int,
+                    shape: Tuple[int, ...], dtype: str):
+        """Device-side boundary-correct layout: slot r of the padded
+        buffer holds exactly rank r's target rows (zero-padded), so the
+        even psum_scatter split lands each rank on its uneven share."""
+        gsize = len(counts)
+        starts = [0]
+        for c in counts[:-1]:
+            starts.append(starts[-1] + c)
+
+        @jax.jit
+        def pack(arr):
+            padded = jnp.zeros((gsize, chunk) + arr.shape[1:], arr.dtype)
+            for r in range(gsize):
+                if counts[r]:
+                    padded = padded.at[r, :counts[r]].set(
+                        jax.lax.slice_in_dim(arr, starts[r],
+                                             starts[r] + counts[r],
+                                             axis=0))
+            return padded.reshape((gsize * chunk,) + arr.shape[1:])
+        return pack
+
     def reducescatter(self, arrays, reduce_op, ps_ranks=()):
         """Rank r receives its dim-0 shard of the sum; first ranks absorb
         the remainder (uneven-split convention matching allgather)."""
@@ -327,29 +557,25 @@ class XlaMeshBackend(Backend):
         prepped, meta = [], []
         for x in arrays:
             was_jax = isinstance(x, jax.Array)
-            arr = np.asarray(x)
+            arr = jnp.asarray(x) if was_jax else \
+                jnp.asarray(np.asarray(x))
             rows = arr.shape[0]
             base, rem = divmod(rows, gsize)
             chunk = base + (1 if rem else 0)
-            counts = [base + (1 if r < rem else 0) for r in range(gsize)]
-            starts = np.cumsum([0] + counts[:-1])
-            # Boundary-correct layout: slot r of the padded buffer holds
-            # exactly rank r's target rows (zero-padded), so the even
-            # psum_scatter split lands each rank on its uneven share.
-            padded = np.zeros((gsize, chunk) + arr.shape[1:], arr.dtype)
-            for r in range(gsize):
-                padded[r, :counts[r]] = arr[starts[r]:starts[r] +
-                                            counts[r]]
-            prepped.append(padded.reshape((gsize * chunk,) +
-                                          arr.shape[1:]))
+            counts = tuple(base + (1 if r < rem else 0)
+                           for r in range(gsize))
+            pack = self._rs_pack_fn(counts, chunk, tuple(arr.shape),
+                                    str(arr.dtype))
+            prepped.append(pack(arr))
             meta.append((was_jax, counts[my_idx]))
         globals_ = [self._to_global(p, mesh, gsize)[0] for p in prepped]
         fn = self._rs_fn(mesh, len(globals_), reduce_op)
         outs = fn(*globals_)
         results = []
         for o, (was_jax, my_count) in zip(outs, meta):
-            mine = np.asarray(o.addressable_data(0))[0][:my_count]
-            results.append(jnp.asarray(mine) if was_jax else mine)
+            mine = o.addressable_data(0)[0]
+            mine = jax.lax.slice_in_dim(mine, 0, my_count, axis=0)
+            results.append(mine if was_jax else np.asarray(mine))
         return results
 
     def barrier(self, ps_ranks=()):
