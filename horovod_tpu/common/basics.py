@@ -173,6 +173,29 @@ def init(comm=None, process_sets=None):
                     state.rank_info.rank = sub_rank
                     state.rank_info.size = len(ranks)
 
+        if state.rank_info.size > 1 and \
+                os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR) is None \
+                and os.environ.get("HOROVOD_RANK0_ADDR") and \
+                os.environ.get(env_mod.HOROVOD_RENDEZVOUS_ADDR):
+            # Static launch with a remote rank 0: the launcher could
+            # not pick valid ports for rank 0's host, so rank 0 picks
+            # them here and publishes via the rendezvous KV
+            # (runner/endpoints.py).
+            from ..runner.endpoints import STATIC_KEY, resolve_endpoints
+            from ..runner.http_server import RendezvousClient
+            client = RendezvousClient(
+                os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR],
+                int(os.environ[env_mod.HOROVOD_RENDEZVOUS_PORT]))
+            eps = resolve_endpoints(
+                client, state.rank_info.rank,
+                os.environ["HOROVOD_RANK0_ADDR"], STATIC_KEY,
+                timeout=float(os.environ.get("HOROVOD_START_TIMEOUT",
+                                             600)))
+            os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = \
+                eps["coordinator"]
+            os.environ["HOROVOD_CONTROLLER_ADDR"] = \
+                eps["controller_addr"]
+
         if state.rank_info.size > 1:
             state.distributed_client_owned = _maybe_init_jax_distributed(
                 state.rank_info)

@@ -33,10 +33,14 @@ def top1_dispatch(gate_logits: jax.Array, capacity: int):
         jnp.int32), capacity, dtype=jnp.float32)           # [T, E, C]
     dispatch = pos_oh * keep[..., None].astype(jnp.float32)
     combine = dispatch * gate[:, None, None]
-    # Load-balancing auxiliary loss (Switch eq. 4).
+    # Load-balancing auxiliary loss (Switch eq. 4):
+    # aux = E * sum_i f_i * P_i, where f_i is the fraction of tokens
+    # routed to expert i and P_i the mean router probability for it.
+    # Uniform routing gives aux == 1.0 regardless of E, so literature
+    # alpha values (e.g. 0.01) transfer unchanged across expert counts.
     density = onehot.mean(axis=0)
     density_proxy = probs.mean(axis=0)
-    aux = (density * density_proxy).sum() * (E * E)
+    aux = (density * density_proxy).sum() * E
     return dispatch, combine, aux
 
 

@@ -23,7 +23,6 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..hosts import (HostInfo, INVALID_SLOT_INFO, SlotInfo,
                      get_host_assignments)
-from ..http_server import find_ports
 from .discovery import HostDiscovery, HostManager
 from .registration import WorkerStateRegistry
 
@@ -216,17 +215,21 @@ class ElasticDriver:
             assignments.setdefault(s.hostname, []).append(s)
         self._host_assignments = assignments
         self._rank0_addr = slots[0].hostname
-        coord_port, ctrl_port = find_ports(2)
         rank0 = slots[0].hostname
         # Local host aliases must resolve from every worker; keep
         # loopback for single-host runs, hostname otherwise.
         from ..tpu_run import is_local
         addr = "127.0.0.1" if is_local(rank0) else rank0
+        # The coordinator/controller ports are chosen by the rank-0
+        # WORKER on its own host (a port free on the driver machine may
+        # be taken on rank 0's host) and published back through the
+        # rendezvous KV under elastic_endpoints/<epoch>; the driver only
+        # advertises the address workers should combine those ports
+        # with.
         self._world_info = {
             "epoch": self._epoch,
             "size": self._world_size,
-            "coordinator": f"{addr}:{coord_port}",
-            "controller_addr": f"{addr}:{ctrl_port}",
+            "rank0_addr": addr,
             # Discovery generation this plan reflects: workers seed
             # their change-poll with it, so a change landing between
             # plan and worker init is still noticed.

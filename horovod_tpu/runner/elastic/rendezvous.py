@@ -14,7 +14,14 @@ Responds JSON::
     {"invalid": true, ...}                         slot retired → exit
     {"rank":R,"size":S,"local_rank":..,"local_size":..,
      "cross_rank":..,"cross_size":..,"epoch":E',
-     "coordinator":"h:p","controller_addr":"h:p"}  new identity
+     "rank0_addr":"h"}                             new identity
+
+The coordinator/controller endpoints are NOT part of this response:
+the rank-0 worker combines ``rank0_addr`` with ports it binds itself
+and publishes them under ``elastic_endpoints/<epoch>`` (see
+runner/endpoints.py); other workers long-poll that key.  Drivers may
+still include explicit ``coordinator``/``controller_addr`` keys as a
+legacy override, which workers honor verbatim.
 """
 
 import json
@@ -59,5 +66,5 @@ class ElasticRendezvousHandler(KVStoreHandler):
         }
         payload.update({k: v for k, v in world.items()
                         if k in ("coordinator", "controller_addr",
-                                 "generation")})
+                                 "rank0_addr", "generation")})
         return json.dumps(payload).encode()

@@ -82,16 +82,30 @@ def elastic_rendezvous(timeout: Optional[float] = None) -> Dict:
         os.environ[env_mod.HOROVOD_LOCAL_SIZE] = str(info["local_size"])
         os.environ[env_mod.HOROVOD_CROSS_RANK] = str(info["cross_rank"])
         os.environ[env_mod.HOROVOD_CROSS_SIZE] = str(info["cross_size"])
-        if "coordinator" in info:
-            os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = \
-                info["coordinator"]
-        if "controller_addr" in info:
-            os.environ["HOROVOD_CONTROLLER_ADDR"] = \
-                info["controller_addr"]
+        _resolve_endpoints(client, info,
+                           max(1.0, deadline - time.monotonic()))
         logger.info("elastic: rendezvous epoch %d rank %d/%d",
                     _last_epoch, info["rank"], info["size"])
         return info
     raise TimeoutError("elastic rendezvous timed out")
+
+
+def _resolve_endpoints(client: RendezvousClient, info: Dict,
+                       timeout: float):
+    """Fix the epoch's coordinator/controller endpoints via the shared
+    rank-0-publishes protocol (see runner/endpoints.py).  Keyed by
+    epoch so each replan gets fresh endpoints.  A driver that still
+    publishes explicit endpoints (tests / older drivers) wins."""
+    if "coordinator" in info and "controller_addr" in info:
+        os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = info["coordinator"]
+        os.environ["HOROVOD_CONTROLLER_ADDR"] = info["controller_addr"]
+        return
+    from ..endpoints import resolve_endpoints
+    endpoints = resolve_endpoints(
+        client, info["rank"], info.get("rank0_addr", "127.0.0.1"),
+        str(info["epoch"]), timeout)
+    os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = endpoints["coordinator"]
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = endpoints["controller_addr"]
 
 
 class RendezvousHostUpdateSource(HostUpdateSource):

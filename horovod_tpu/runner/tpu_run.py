@@ -141,14 +141,24 @@ def launch_static(command: List[str],
     else:
         rank0_addr = rank0_host
 
-    coordinator_port, controller_port = find_ports(2)
     common_env = {
         "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
         "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
         "HOROVOD_CONTROLLER": "tcp",
-        "HOROVOD_TPU_COORDINATOR": f"{rank0_addr}:{coordinator_port}",
-        "HOROVOD_CONTROLLER_ADDR": f"{rank0_addr}:{controller_port}",
     }
+    if is_local(rank0_host):
+        # Rank 0 binds on this machine, so ports probed here are valid.
+        coordinator_port, controller_port = find_ports(2)
+        common_env["HOROVOD_TPU_COORDINATOR"] = \
+            f"{rank0_addr}:{coordinator_port}"
+        common_env["HOROVOD_CONTROLLER_ADDR"] = \
+            f"{rank0_addr}:{controller_port}"
+    else:
+        # Rank 0 is remote: a port free here may be taken there.  The
+        # rank-0 worker picks its own ports and publishes them through
+        # the rendezvous KV (runner/endpoints.py); workers resolve at
+        # init.
+        common_env["HOROVOD_RANK0_ADDR"] = rank0_addr
     if start_timeout:
         # Bounds how long workers wait for each other at init
         # (consumed by the controller's connect loop).

@@ -30,8 +30,29 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
         self._process_set = process_set
 
     def call(self, inputs, training=None, mask=None):
-        if not training or self._process_set.size() == 1:
+        if self._process_set.size() == 1 or training is None:
             return super().call(inputs, training=training, mask=mask)
+        # ``training`` may be a symbolic tensor under tf.function
+        # tracing; ``not training`` would then branch on the Python
+        # truthiness of the tensor object (always True) instead of its
+        # value.  Resolve a static value when possible, else tf.cond.
+        if isinstance(training, (bool, int, np.bool_)):
+            static_training = bool(training)
+        else:
+            import tensorflow as tf
+            static_training = tf.get_static_value(training)
+            if static_training is None:
+                return tf.cond(
+                    tf.cast(training, tf.bool),
+                    lambda: self._sync_call(inputs, mask),
+                    lambda: super(SyncBatchNormalization, self).call(
+                        inputs, training=False, mask=mask))
+            static_training = bool(static_training)
+        if not static_training:
+            return super().call(inputs, training=False, mask=mask)
+        return self._sync_call(inputs, mask)
+
+    def _sync_call(self, inputs, mask=None):
         if keras.backend.backend() != "tensorflow":
             raise RuntimeError(
                 "horovod_tpu.tensorflow.SyncBatchNormalization requires "

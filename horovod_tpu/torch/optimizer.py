@@ -180,11 +180,36 @@ class _DistributedAdasumOptimizer(torch.optim.Optimizer):
         self._compression = compression
         self.backward_passes_per_step = backward_passes_per_step
         self._step_count = 0
+        self._grad_accum: Dict[torch.Tensor, torch.Tensor] = {}
 
     def step(self, closure=None):
         self._step_count += 1
-        if self._step_count % self.backward_passes_per_step != 0:
-            return None
+        if self.backward_passes_per_step > 1:
+            # Fold this pass's gradients into a local buffer and zero
+            # p.grad, so every batch contributes exactly once to the
+            # eventual Adasum step regardless of whether the caller
+            # zero_grad()s between passes (reference: torch/optimizer.py
+            # backward_passes_per_step local accumulation).
+            with torch.no_grad():
+                for group in self.param_groups:
+                    for p in group["params"]:
+                        if p.grad is None:
+                            continue
+                        buf = self._grad_accum.get(p)
+                        if buf is None:
+                            self._grad_accum[p] = p.grad.detach().clone()
+                        else:
+                            buf.add_(p.grad)
+                        p.grad.zero_()
+            if self._step_count % self.backward_passes_per_step != 0:
+                return None
+            with torch.no_grad():
+                for p, buf in self._grad_accum.items():
+                    if p.grad is None:
+                        p.grad = buf.clone()
+                    else:
+                        p.grad.copy_(buf)
+            self._grad_accum.clear()
         # Save pre-step parameters, apply the local update, then
         # Adasum-reduce the deltas and re-apply.
         starts = {}

@@ -92,7 +92,10 @@ def test_driver_start_assigns_ranks():
     assert slot.rank == 3 and slot.size == 4
     assert slot.cross_rank == 1 and slot.cross_size == 2
     assert world["size"] == 4
-    assert "coordinator" in world and "controller_addr" in world
+    # Ports are chosen by the rank-0 worker on its own host; the driver
+    # only advertises the address to combine them with.
+    assert "rank0_addr" in world
+    assert "coordinator" not in world and "controller_addr" not in world
     workers.release_all(0)
     assert driver.join(timeout=10)
     assert driver.error_message is None

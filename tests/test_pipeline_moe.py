@@ -86,6 +86,36 @@ def test_top1_dispatch_capacity():
     assert float(aux) > 0
 
 
+@pytest.mark.parametrize("E", [2, 8])
+def test_moe_aux_loss_switch_oracle(E):
+    """aux must equal the Switch Transformer eq. 4 value
+    E * sum_i f_i * P_i (f_i = fraction of tokens argmax-routed to
+    expert i, P_i = mean router probability) — NOT E x that value."""
+    T = 64
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    _, _, aux = top1_dispatch(logits, capacity=T)
+
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    assign = probs.argmax(axis=-1)
+    f = np.array([(assign == e).mean() for e in range(E)])
+    P = probs.mean(axis=0)
+    oracle = E * float((f * P).sum())
+    np.testing.assert_allclose(float(aux), oracle, rtol=1e-5)
+
+
+@pytest.mark.parametrize("E", [2, 8])
+def test_moe_aux_loss_balanced_is_one(E):
+    """Perfectly balanced, confident routing gives aux ~= 1.0 for any
+    expert count, so literature alpha values transfer across E."""
+    T = 8 * E
+    assign = np.arange(T) % E
+    logits = jnp.asarray(
+        (np.eye(E)[assign] * 50.0).astype(np.float32))
+    _, _, aux = top1_dispatch(logits, capacity=T)
+    np.testing.assert_allclose(float(aux), 1.0, atol=1e-3)
+
+
 def test_moe_matches_per_token_expert():
     """Expert-parallel MoE must equal routing each token through its
     argmax expert locally (capacity ample, identical tokens per rank)."""

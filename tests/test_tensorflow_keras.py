@@ -140,6 +140,35 @@ def test_sync_batch_norm_single(hvd_tf):
     np.testing.assert_allclose(got.std(axis=0), np.ones(4), atol=2e-2)
 
 
+def test_sync_batch_norm_symbolic_training(hvd_tf):
+    """Under tf.function, ``training`` arrives as a symbolic tensor;
+    the layer must branch on its VALUE via tf.cond (regression: the
+    Python truthiness test either raised or always took one branch)."""
+    layer = hvd_tf.SyncBatchNormalization(axis=-1)
+    x = tf.random.normal([8, 3])
+    layer.build(x.shape)
+
+    class _FakePS:
+        def size(self):
+            return 2
+
+    layer._process_set = _FakePS()
+    # Patch the sync path so the test exercises branch selection
+    # without needing a second rank behind the allreduce.
+    layer._sync_call = lambda inputs, mask=None: \
+        tf.convert_to_tensor(inputs) + 100.0
+
+    @tf.function
+    def run(x, training):
+        return layer.call(x, training=training)
+
+    out_train = run(x, tf.constant(True))
+    np.testing.assert_allclose(out_train.numpy(), x.numpy() + 100.0,
+                               rtol=1e-5)
+    out_infer = run(x, tf.constant(False))
+    assert not np.allclose(out_infer.numpy(), x.numpy() + 100.0)
+
+
 def test_keras_elastic_state(hvd_tf):
     import horovod_tpu.keras.elastic as ke
     model = _make_model()

@@ -136,6 +136,47 @@ def test_adasum_optimizer(hvd_t):
     assert not torch.allclose(model.weight, w0)
 
 
+def test_adasum_backward_passes_accumulate(hvd_t):
+    """With backward_passes_per_step=N, all N batches' gradients must
+    contribute to the eventual step (regression: intermediate passes
+    were silently discarded when the caller zero_grad()s between
+    them).  At world size 1 Adasum is identity, so the final params
+    must equal one SGD step on the SUM of both passes' gradients."""
+    p = torch.nn.Parameter(torch.tensor([1.0, 2.0]))
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD([p], lr=0.1),
+        named_parameters=[("p", p)], op=hvd_t.Adasum,
+        backward_passes_per_step=2)
+    g1 = torch.tensor([1.0, 1.0])
+    g2 = torch.tensor([2.0, -1.0])
+    (p * g1).sum().backward()
+    assert opt.step() is None        # intermediate pass: no update yet
+    opt.zero_grad()
+    (p * g2).sum().backward()
+    opt.step()
+    expected = torch.tensor([1.0, 2.0]) - 0.1 * (g1 + g2)
+    assert torch.allclose(p.detach(), expected, atol=1e-6)
+
+
+def test_adasum_backward_passes_no_zero_grad(hvd_t):
+    """Standard PyTorch accumulation (no zero_grad between passes)
+    must not double-count pass-1 gradients: the optimizer folds each
+    pass into its buffer and zeroes p.grad itself."""
+    p = torch.nn.Parameter(torch.tensor([1.0, 2.0]))
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD([p], lr=0.1),
+        named_parameters=[("p", p)], op=hvd_t.Adasum,
+        backward_passes_per_step=2)
+    g1 = torch.tensor([1.0, 1.0])
+    g2 = torch.tensor([2.0, -1.0])
+    (p * g1).sum().backward()
+    opt.step()
+    (p * g2).sum().backward()   # no zero_grad: grads would accumulate
+    opt.step()
+    expected = torch.tensor([1.0, 2.0]) - 0.1 * (g1 + g2)
+    assert torch.allclose(p.detach(), expected, atol=1e-6)
+
+
 def test_sync_batch_norm_single(hvd_t):
     bn = hvd_t.SyncBatchNorm(4)
     bn.train()
