@@ -353,8 +353,13 @@ class CoordinatorServer:
                     self._joined.clear()
                 else:
                     # Tensors waiting only on the joined rank are
-                    # now complete (zeros substituted).
+                    # now complete (zeros substituted).  Force the
+                    # full-negotiation path: a cached response would
+                    # carry the joined rank's old contribution (e.g.
+                    # nonzero allgather row counts) whereas
+                    # construct_response records zeros for it.
                     for cname, msgs in self._scan_complete():
+                        self._bit_only[cname] = False
                         ready.append((cname, msgs, None))
                 continue
             if req.request_type == RequestType.BARRIER:
@@ -420,7 +425,10 @@ class CoordinatorServer:
             bit_only = self._bit_only.pop(name, False)
             self._stall_logged.pop(name, None)
             ent = self._cache.get(name)
-            if bit_only and ent is not None and \
+            # While any rank is joined, cached responses are stale for
+            # it (renegotiation substitutes zeros for joined ranks) —
+            # bypass the fast path entirely.
+            if bit_only and ent is not None and not self._joined and \
                     self._group_ids.get(name, -1) not in full_groups:
                 hit_responses.append(ent[1])
                 self.stats["fast_tensors"] += 1
@@ -803,10 +811,22 @@ class NetworkController(Controller):
         if pending:
             hit_bits: List[int] = []
             full: List[Request] = []
-            for req in pending:
-                bit = self.cache.lookup_bit(req) \
-                    if self.cache.enabled else None
-                if bit is not None:
+            # Group atomicity: a grouped submission travels in ONE
+            # frame per rank (runtime.submit_group + pop_pending), so
+            # demoting the WHOLE group to full requests whenever any
+            # member misses the cache keeps all members' completion
+            # counts in lockstep on the coordinator — members can
+            # never finish in different rounds (one in a CB batch,
+            # another in a later RS frame).
+            lookups = [self.cache.lookup_bit(req)
+                       if self.cache.enabled else None
+                       for req in pending]
+            demoted_gids = {req.group_id
+                            for req, bit in zip(pending, lookups)
+                            if bit is None and req.group_id >= 0}
+            for req, bit in zip(pending, lookups):
+                if bit is not None and (req.group_id < 0 or
+                                        req.group_id not in demoted_gids):
                     hit_bits.append(bit)
                 else:
                     full.append(req)

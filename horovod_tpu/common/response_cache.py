@@ -23,10 +23,12 @@ instead of O(tensors) full request/response payloads:
   * When EVERY participating rank contributed via bit, the coordinator
     broadcasts a CB frame: fused batches of bits in execution order.
     Workers reconstruct the fused Response locally from their caches.
-  * Any full request for a cached tensor (signature change, worker-side
-    eviction) forces the coordinator to evict + renegotiate, and the
-    re-broadcast re-seeds everyone — self-healing, no eviction
-    consensus needed.  EV frames bound worker cache growth.
+  * Any full request for a cached tensor (signature change) forces the
+    coordinator to evict + renegotiate, and the re-broadcast re-seeds
+    everyone — self-healing, no eviction consensus needed.  Workers
+    never evict on their own: EV frames (coordinator capacity-LRU or
+    invalidation) are the only way entries leave a worker cache, so
+    worker caches always cover the coordinator's live bits.
 
 On TPU the cache is *load-bearing*: a cache hit means the fused batch
 signature is unchanged, so the compiled XLA executable for the batch is
@@ -139,7 +141,14 @@ class WorkerResponseCache:
     """Per-rank cache: name → (coordinator bit, per-tensor response,
     this rank's request signature).  Entries without a signature (this
     rank never submitted the tensor — e.g. non-members of a process set,
-    joined ranks) still resolve CB bits but never produce hits."""
+    joined ranks) still resolve CB bits but never produce hits.
+
+    Workers NEVER evict on their own: eviction follows coordinator EV
+    frames exclusively, so the worker's entry set is always a superset
+    of the coordinator's live bits no matter how per-rank capacity
+    knobs are (mis)configured — a CB frame can then never reference a
+    bit the worker dropped unilaterally.  The coordinator's capacity
+    bounds growth for everyone."""
 
     def __init__(self, capacity: int = 1024):
         self.capacity = capacity
@@ -173,9 +182,6 @@ class WorkerResponseCache:
             old = self._entries.pop(name, None)
             if old is not None:
                 self._bit_names.pop(old[0], None)
-            while len(self._entries) >= self.capacity > 0:
-                _, (old_bit, _, _) = self._entries.popitem(last=False)
-                self._bit_names.pop(old_bit, None)
             self._entries[name] = [bit, response, sig]
             self._bit_names[bit] = name
 
@@ -235,6 +241,10 @@ class CoordinatorCache:
         honored but forces the full negotiation path."""
         name = self._bit_names.get(bit)
         if name is not None:
+            # LRU: a bit contribution marks the tensor hot, so capacity
+            # eviction prefers tensors no rank is actively using
+            # (reference response_cache.h:45-102 LRU semantics).
+            self._entries.move_to_end(name)
             ent = self._entries[name]
             return True, name, ent[2], ent[1].tensor_sizes, ent[3]
         tomb = self._tombstones.get(bit)

@@ -448,6 +448,12 @@ class CoordCache {
     auto it = bit_names_.find(bit);
     if (it != bit_names_.end()) {
       Entry& e = entries_[it->second];
+      // LRU: a bit contribution marks the tensor hot, so capacity
+      // eviction prefers tensors no rank is actively using
+      // (response_cache.py resolve_bit; reference response_cache.h
+      // LRU semantics).  O(1) splice — this runs once per cached
+      // tensor per step on the coordinator thread.
+      touch_order(it->second);
       *name = it->second;
       *sig = e.sig;
       *sizes = e.resp.sizes;
@@ -497,6 +503,7 @@ class CoordCache {
     int32_t bit = next_bit_++;
     entries_[name] = Entry{bit, resp, sig, gid};
     order_.push_back(name);
+    order_it_[name] = std::prev(order_.end());
     bit_names_[bit] = name;
     return bit;
   }
@@ -535,17 +542,24 @@ class CoordCache {
     }
   }
   void remove_order(const std::string& name) {
-    for (auto it = order_.begin(); it != order_.end(); ++it) {
-      if (*it == name) {
-        order_.erase(it);
-        return;
-      }
-    }
+    auto it = order_it_.find(name);
+    if (it == order_it_.end()) return;
+    order_.erase(it->second);
+    order_it_.erase(it);
+  }
+
+  // Move to the most-recently-used end in O(1).
+  void touch_order(const std::string& name) {
+    auto it = order_it_.find(name);
+    if (it == order_it_.end()) return;
+    order_.splice(order_.end(), order_, it->second);
+    it->second = std::prev(order_.end());
   }
 
   int capacity_;
   std::map<std::string, Entry> entries_;
-  std::deque<std::string> order_;  // FIFO insertion order
+  std::list<std::string> order_;  // LRU order, front = coldest
+  std::map<std::string, std::list<std::string>::iterator> order_it_;
   std::map<int32_t, std::string> bit_names_;
   std::map<int32_t, Tomb> tombstones_;
   std::deque<int32_t> tomb_order_;
@@ -782,6 +796,9 @@ class Coordinator {
   }
 
   // Tensors waiting only on joined (departed) ranks became complete.
+  // These must renegotiate in full: a cached response would carry the
+  // joined rank's old contribution (e.g. nonzero allgather row
+  // counts) whereas construct_response records zeros for it.
   void ScanComplete(
       std::vector<std::pair<std::string, std::vector<Request>>>* ready) {
     std::vector<std::string> done;
@@ -797,6 +814,7 @@ class Coordinator {
     for (const auto& n : done) {
       table_.erase(n);
       first_seen_.erase(n);
+      bit_only_[n] = false;
     }
   }
 
@@ -970,11 +988,16 @@ class Coordinator {
       if (!errs.empty()) BroadcastLocked(errs);
       return;
     }
-    // (name, msgs) for completed negotiations; direct responses for
-    // join/barrier control flow.
-    std::vector<std::pair<std::string, std::vector<Request>>> completed;
-    std::vector<std::pair<size_t, Response>> direct;  // order anchor
-    size_t order = 0;
+    // Completed negotiations and direct (join/barrier) responses, in
+    // one ordered list so the broadcast interleaves them exactly as
+    // they completed (matching controller_net.py's ready list).
+    struct ReadyItem {
+      std::string name;
+      std::vector<Request> msgs;  // empty for direct responses
+      bool is_direct = false;
+      Response direct;
+    };
+    std::vector<ReadyItem> ready;
     for (const auto& item : items) {
       const Request& req = item.first;
       bool from_cache = item.second;
@@ -986,16 +1009,23 @@ class Coordinator {
         joined_.insert(rank);
         last_joined_ = rank;
         if (int(joined_.size()) == size_) {
-          Response r;
-          r.type = RESP_JOIN;
-          r.names = {"join"};
-          r.last_joined = last_joined_;
-          direct.emplace_back(order++, std::move(r));
+          ReadyItem ri;
+          ri.is_direct = true;
+          ri.direct.type = RESP_JOIN;
+          ri.direct.names = {"join"};
+          ri.direct.last_joined = last_joined_;
+          ready.push_back(std::move(ri));
           joined_.clear();
         } else {
-          size_t before = completed.size();
-          ScanComplete(&completed);
-          order += completed.size() - before;
+          std::vector<std::pair<std::string, std::vector<Request>>>
+              scanned;
+          ScanComplete(&scanned);
+          for (auto& kv : scanned) {
+            ReadyItem ri;
+            ri.name = std::move(kv.first);
+            ri.msgs = std::move(kv.second);
+            ready.push_back(std::move(ri));
+          }
         }
         continue;
       }
@@ -1005,20 +1035,22 @@ class Coordinator {
         arrived.insert(rank);
         if (int(arrived.size()) >= required) {
           barriers_.erase(req.name);
-          Response r;
-          r.type = RESP_BARRIER;
-          r.names = {req.name};
-          r.psid = req.psid;
-          r.psr = req.psr;
-          direct.emplace_back(order++, std::move(r));
+          ReadyItem ri;
+          ri.is_direct = true;
+          ri.direct.type = RESP_BARRIER;
+          ri.direct.names = {req.name};
+          ri.direct.psid = req.psid;
+          ri.direct.psr = req.psr;
+          ready.push_back(std::move(ri));
         }
         continue;
       }
       if (!from_cache) {
         bit_only_[req.name] = false;
         if (cache_.has(req.name)) {
-          // Signature changed on some rank (or worker-side eviction):
-          // renegotiate so a stale response can never serve.
+          // Signature changed on some rank (or worker-side
+          // invalidation): renegotiate so a stale response can never
+          // serve.
           int32_t bit = cache_.evict_name(req.name);
           if (bit >= 0) pending_evictions_.push_back(bit);
         }
@@ -1031,23 +1063,45 @@ class Coordinator {
       auto& msgs = table_[req.name];
       msgs.push_back(req);
       if (int(msgs.size()) + JoinedCountFor(req) >= required) {
-        completed.emplace_back(req.name, std::move(msgs));
+        ReadyItem ri;
+        ri.name = req.name;
+        ri.msgs = std::move(msgs);
         table_.erase(req.name);
         first_seen_.erase(req.name);
-        ++order;
+        ready.push_back(std::move(ri));
       }
     }
-    if (completed.empty() && direct.empty()) {
+    if (ready.empty()) {
       FlushEvictionsLocked();
       return;
+    }
+
+    // Group atomicity: a grouped submission must not straddle the CB
+    // and RS frames — if any member renegotiates this round, every
+    // member of that group is demoted to the full path
+    // (controller_net.py full_groups).
+    std::set<int32_t> full_gids;
+    for (const auto& ri : ready) {
+      if (ri.is_direct) continue;
+      auto bo = bit_only_.find(ri.name);
+      bool bit_only = bo != bit_only_.end() && bo->second;
+      if (!(bit_only && cache_.get(ri.name) != nullptr)) {
+        auto git = group_ids_.find(ri.name);
+        if (git != group_ids_.end() && git->second >= 0)
+          full_gids.insert(git->second);
+      }
     }
 
     // Partition: pure-bit rounds ride the compact CB frame.
     std::vector<Response> hit_responses;
     std::vector<Response> full_responses;
     std::map<std::string, Sig> sig_by_name;
-    for (auto& kv : completed) {
-      const std::string& name = kv.first;
+    for (auto& ri : ready) {
+      if (ri.is_direct) {
+        full_responses.push_back(std::move(ri.direct));
+        continue;
+      }
+      const std::string& name = ri.name;
       bool bit_only = false;
       auto bo = bit_only_.find(name);
       if (bo != bit_only_.end()) {
@@ -1055,16 +1109,22 @@ class Coordinator {
         bit_only_.erase(bo);
       }
       CoordCache::Entry* ent = cache_.get(name);
-      if (bit_only && ent != nullptr) {
+      int32_t gid = -1;
+      auto git = group_ids_.find(name);
+      if (git != group_ids_.end()) gid = git->second;
+      // While any rank is joined, cached responses are stale for it
+      // (renegotiation substitutes zeros for joined ranks) — bypass
+      // the fast path entirely.
+      if (bit_only && ent != nullptr && joined_.empty() &&
+          (gid < 0 || !full_gids.count(gid))) {
         hit_responses.push_back(ent->resp);
         continue;
       }
-      Response resp = construct_response(name, kv.second, size_);
-      sig_by_name[name] = make_sig(kv.second[0]);
+      Response resp = construct_response(name, ri.msgs, size_);
+      sig_by_name[name] = make_sig(ri.msgs[0]);
       full_responses.push_back(std::move(resp));
       cache_.clear_tombstones_for(name);
     }
-    for (auto& d : direct) full_responses.push_back(std::move(d.second));
 
     int64_t nbytes = 0;
     if (!hit_responses.empty()) {

@@ -75,7 +75,10 @@ def test_worker_cache_hit_and_invalidate():
     assert cache.response_for_bit(7) is None
 
 
-def test_worker_cache_capacity_fifo():
+def test_worker_cache_never_self_evicts():
+    """Workers evict ONLY on coordinator EV frames: a capacity smaller
+    than the coordinator's must not silently drop entries (a CB frame
+    referencing the dropped bit would kill the job)."""
     from horovod_tpu.common.message import Response, ResponseType
     from horovod_tpu.common.response_cache import WorkerResponseCache
     cache = WorkerResponseCache(capacity=2)
@@ -83,9 +86,45 @@ def test_worker_cache_capacity_fifo():
         cache.insert(name, i, Response(
             response_type=ResponseType.ALLREDUCE, tensor_names=[name]),
             None)
+    assert len(cache) == 3                        # over capacity, kept
+    assert cache.response_for_bit(0) is not None
+    cache.evict_bits([0, 1])                      # EV frame
+    assert len(cache) == 1
+    assert cache.response_for_bit(0) is None
+    assert cache.response_for_bit(2) is not None
+
+
+def test_coordinator_cache_lru():
+    """Capacity eviction is LRU over bit contributions: a hot tensor
+    outlives capacity-many cold inserts (reference
+    response_cache.h:45-102)."""
+    from horovod_tpu.common.message import (DataType, Request, RequestType,
+                                            Response, ResponseType)
+    from horovod_tpu.common.response_cache import (CoordinatorCache,
+                                                   request_signature)
+
+    def mk(name):
+        req = Request(request_rank=0, request_type=RequestType.ALLREDUCE,
+                      tensor_name=name, tensor_shape=(4,),
+                      tensor_type=DataType.FLOAT32)
+        resp = Response(response_type=ResponseType.ALLREDUCE,
+                        tensor_names=[name], tensor_shapes=[(4,)])
+        return resp, request_signature(req)
+
+    cache = CoordinatorCache(capacity=2)
+    resp, sig = mk("hot")
+    hot_bit, _ = cache.insert("hot", resp, sig, -1)
+    resp, sig = mk("b")
+    cache.insert("b", resp, sig, -1)
+    for i in range(5):
+        # A bit contribution marks "hot" as recently used ...
+        live, name, *_ = cache.resolve_bit(hot_bit)
+        assert live and name == "hot"
+        # ... so the cold entry is the eviction victim, never "hot".
+        resp, sig = mk(f"cold{i}")
+        _, evicted = cache.insert(f"cold{i}", resp, sig, -1)
+        assert cache.has("hot"), f"hot evicted at cold insert {i}"
     assert len(cache) == 2
-    assert cache.response_for_bit(0) is None      # "a" evicted (FIFO)
-    assert cache.response_for_bit(2) is not None  # "c" present
 
 
 def test_coordinator_cache_tombstones():
@@ -244,6 +283,73 @@ def test_grouped_allreduce_past_threshold_2proc(native):
         print("OK")
     """, nproc=2, extra_env={"HOROVOD_TPU_NATIVE": native,
                              "HOROVOD_FUSION_THRESHOLD": "4096"})
+    assert_all_ok(results)
+
+
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_cache_bypassed_while_rank_joined(native):
+    """A cached allgather must NOT serve from the fast path once a rank
+    joined: the cached response carries the joined rank's old nonzero
+    row counts, whereas renegotiation records 0 rows for it."""
+    results = run_workers("""
+        import time
+        # Steady state: cache the allgather (per-rank rows RANK+1).
+        for step in range(5):
+            g = np.asarray(hvd.allgather(
+                np.full((RANK + 1, 2), float(step), np.float32),
+                name="jg"))
+            assert g.shape == (3, 2), g.shape
+        if RANK == 1:
+            hvd.join()
+        else:
+            time.sleep(1.5)   # let rank 1's join land first
+            # Same signature -> this rank submits via cache bit; the
+            # coordinator must renegotiate (not serve the cached
+            # 2-rows-from-rank-1 layout).
+            g = np.asarray(hvd.allgather(
+                np.full((1, 2), 7.0, np.float32), name="jg"))
+            assert g.shape == (1, 2), g.shape
+            np.testing.assert_allclose(g, 7.0)
+            hvd.join()
+        print("OK")
+    """, nproc=2, extra_env={"HOROVOD_TPU_NATIVE": native})
+    assert_all_ok(results)
+
+
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_group_invalidation_demotes_whole_group(native):
+    """When ONE member of a grouped submission invalidates (shape
+    change), the whole group must renegotiate in a single round — no
+    member may ride a CB frame while another goes through RS (group
+    atomicity across the CH/RQ split)."""
+    results = run_workers("""
+        from horovod_tpu.common import basics
+        ctrl = basics._state().runtime.controller
+        xs = [np.full((8,), float(i + 1), np.float32) for i in range(3)]
+        for rep in range(6):
+            ys = hvd.grouped_allreduce(xs, op=hvd.Sum, name="gg")
+            for i, y in enumerate(ys):
+                np.testing.assert_allclose(np.asarray(y),
+                                           2.0 * (i + 1))
+        ch_before = ctrl.stats["ch_frames"]
+        # Member 1 changes shape; members 0 and 2 still match their
+        # cached signatures but must be demoted with it.
+        xs2 = [np.full((8,), 1.0, np.float32),
+               np.full((4,), 2.0, np.float32),
+               np.full((8,), 3.0, np.float32)]
+        ys = hvd.grouped_allreduce(xs2, op=hvd.Sum, name="gg")
+        np.testing.assert_allclose(np.asarray(ys[0]), 2.0)
+        np.testing.assert_allclose(np.asarray(ys[1]), 4.0)
+        np.testing.assert_allclose(np.asarray(ys[2]), 6.0)
+        # No cache bits may have been sent for the demoted round.
+        assert ctrl.stats["ch_frames"] == ch_before, ctrl.stats
+        # Steady state resumes on the new signatures.
+        for rep in range(3):
+            ys = hvd.grouped_allreduce(xs2, op=hvd.Sum, name="gg")
+            np.testing.assert_allclose(np.asarray(ys[1]), 4.0)
+        assert ctrl.stats["ch_frames"] > ch_before, ctrl.stats
+        print("OK")
+    """, nproc=2, extra_env={"HOROVOD_TPU_NATIVE": native})
     assert_all_ok(results)
 
 
