@@ -641,9 +641,20 @@ class NetworkController(Controller):
         allow_ephemeral = self._rendezvous_client() is not None
         stall_warn = 0.0 if state.knobs.stall_check_disable else \
             state.knobs.stall_warning_time_s
+        # When the user EXPLICITLY set HOROVOD_TPU_NATIVE to a truthy
+        # value, a missing/broken native build is an error, not a
+        # silent fallback — otherwise native-path tests pass vacuously
+        # against the Python coordinator.
+        strict_native = os.environ.get(
+            "HOROVOD_TPU_NATIVE", "").strip().lower() in ("1", "true",
+                                                          "on", "yes")
         if state.timeline is None:
             try:
                 from ..native import NativeCoordinatorServer, available
+                if strict_native and not available():
+                    raise RuntimeError(
+                        "HOROVOD_TPU_NATIVE is set but the native "
+                        "coordinator could not be built/loaded")
                 if available():
                     return NativeCoordinatorServer(
                         self.size, port=port,
@@ -659,6 +670,8 @@ class NetworkController(Controller):
             except OSError:
                 raise   # bind failure: same semantics as Python server
             except Exception:
+                if strict_native:
+                    raise
                 logger.warning("native coordinator unavailable; using "
                                "the Python coordinator", exc_info=True)
         return CoordinatorServer(
