@@ -16,10 +16,13 @@ from ..runner.hosts import HostInfo, get_host_assignments, slot_env_vars
 from ..runner.http_server import RendezvousServer, find_ports, \
     local_addresses
 from .store import FilesystemStore, Store
+from .backend import Backend, LocalBackend, SparkBackend
+from .estimator import HorovodEstimator, HorovodModel
 
 logger = logging.getLogger("horovod_tpu.spark")
 
-__all__ = ["run", "Store", "FilesystemStore"]
+__all__ = ["run", "Store", "FilesystemStore", "Backend", "LocalBackend",
+           "SparkBackend", "HorovodEstimator", "HorovodModel"]
 
 
 def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,

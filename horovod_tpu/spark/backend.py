@@ -1,0 +1,139 @@
+"""Training backends for the estimator API.
+
+Reference: spark/backend.py — ``SparkBackend`` runs the training
+function on Spark barrier tasks.  Here ``SparkBackend`` wraps
+:func:`horovod_tpu.spark.run` (barrier stage + rendezvous), and
+``LocalBackend`` runs the same function on N local worker processes
+over the launcher env contract — the estimator is fully usable (and
+testable) without a Spark cluster, which is also the natural mode on a
+single TPU-VM host.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Callable, List, Optional
+
+
+class Backend:
+    def num_processes(self) -> int:
+        raise NotImplementedError()
+
+    def run(self, fn: Callable, args=(), extra_env: Optional[dict] = None
+            ) -> List:
+        """Run ``fn(*args)`` on every worker; returns per-rank results
+        ordered by rank."""
+        raise NotImplementedError()
+
+
+class SparkBackend(Backend):
+    """Barrier-stage backend (reference: spark/backend.py SparkBackend)."""
+
+    def __init__(self, num_proc: Optional[int] = None, verbose: int = 2):
+        self._num_proc = num_proc
+        self._verbose = verbose
+
+    def num_processes(self) -> int:
+        if self._num_proc is not None:
+            return self._num_proc
+        from pyspark.sql import SparkSession
+        sc = SparkSession.builder.getOrCreate().sparkContext
+        return max(int(sc.defaultParallelism), 1)
+
+    def run(self, fn, args=(), extra_env=None):
+        from . import run as spark_run
+        return spark_run(fn, args=args, num_proc=self.num_processes(),
+                         extra_env=extra_env, verbose=self._verbose)
+
+
+_WORKER_MAIN = r"""
+import os, pickle, sys
+with open(os.environ["HVD_ESTIMATOR_FN"], "rb") as f:
+    payload = f.read()
+import cloudpickle
+fn, args = cloudpickle.loads(payload)
+result = fn(*args)
+out = os.environ["HVD_ESTIMATOR_OUT"]
+tmp = out + ".tmp"
+with open(tmp, "wb") as f:
+    f.write(cloudpickle.dumps(result))
+os.replace(tmp, out)
+"""
+
+
+class LocalBackend(Backend):
+    """Run the training function on N local processes wired through the
+    standard env contract (the same processes `horovodrun -np N -H
+    localhost:N` would start)."""
+
+    def __init__(self, num_proc: int = 2, verbose: int = 1,
+                 use_tpu: bool = False, timeout: float = 600.0):
+        self._num_proc = num_proc
+        self._verbose = verbose
+        self._use_tpu = use_tpu
+        self._timeout = timeout
+
+    def num_processes(self) -> int:
+        return self._num_proc
+
+    def run(self, fn, args=(), extra_env=None):
+        import cloudpickle
+        from ..runner.http_server import find_ports
+
+        nproc = self._num_proc
+        coord_port, ctrl_port = find_ports(2)
+        with tempfile.TemporaryDirectory(prefix="hvd_est_") as tmp:
+            fn_path = os.path.join(tmp, "fn.pkl")
+            with open(fn_path, "wb") as f:
+                f.write(cloudpickle.dumps((fn, args)))
+            procs, outs = [], []
+            for rank in range(nproc):
+                out_path = os.path.join(tmp, f"out.{rank}.pkl")
+                outs.append(out_path)
+                env = dict(os.environ)
+                env.update({
+                    "HOROVOD_RANK": str(rank),
+                    "HOROVOD_SIZE": str(nproc),
+                    "HOROVOD_LOCAL_RANK": str(rank),
+                    "HOROVOD_LOCAL_SIZE": str(nproc),
+                    "HOROVOD_CROSS_RANK": "0",
+                    "HOROVOD_CROSS_SIZE": "1",
+                    "HOROVOD_TPU_COORDINATOR": f"127.0.0.1:{coord_port}",
+                    "HOROVOD_CONTROLLER_ADDR": f"127.0.0.1:{ctrl_port}",
+                    "HVD_ESTIMATOR_FN": fn_path,
+                    "HVD_ESTIMATOR_OUT": out_path,
+                })
+                if nproc > 1 and not self._use_tpu:
+                    # One TPU chip cannot be shared by N processes;
+                    # multi-proc local training rides the CPU data plane.
+                    env["HOROVOD_TPU_FORCE_CPU"] = "1"
+                    env["JAX_PLATFORMS"] = "cpu"
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _WORKER_MAIN], env=env,
+                    stdout=None if self._verbose >= 2 else subprocess.PIPE,
+                    stderr=subprocess.STDOUT))
+            failures = []
+            tails = []
+            for rank, p in enumerate(procs):
+                try:
+                    out, _ = p.communicate(timeout=self._timeout)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                    failures.append(rank)
+                if p.returncode != 0:
+                    failures.append(rank)
+                    if out:
+                        tails.append(out.decode(errors="replace")[-2000:])
+            if failures:
+                detail = ("\n".join(tails))[-4000:]
+                raise RuntimeError(
+                    f"estimator worker(s) {sorted(set(failures))} failed"
+                    + (f":\n{detail}" if detail else ""))
+            results = []
+            for rank, path in enumerate(outs):
+                with open(path, "rb") as f:
+                    results.append(pickle.loads(f.read()))
+            return results

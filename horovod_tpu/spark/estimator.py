@@ -1,0 +1,144 @@
+"""Estimator API: fit a model to a DataFrame on distributed workers.
+
+Reference: spark/common/estimator.py:25-108 — ``HorovodEstimator.fit``
+materializes the DataFrame through the Store, runs a remote trainer on
+every worker via the backend, and returns a ``HorovodModel``
+transformer; ``_has_checkpoint``/per-epoch checkpoints in the Store
+give resumable runs.  The TPU-first deltas: npz shards instead of
+Petastorm parquet, a LocalBackend so a single TPU-VM host works
+without a Spark cluster, and framework trainers that drive the
+horovod_tpu bindings (DistributedOptimizer + broadcast) over the XLA
+data plane.
+"""
+
+import json
+import os
+import uuid
+from typing import List, Optional
+
+from .backend import Backend, LocalBackend
+from .params import Params
+from . import util
+
+CHECKPOINT_META = "checkpoint.meta.json"
+
+
+class EstimatorParams(Params):
+    _params = dict(
+        num_proc=None, model=None, backend=None, store=None,
+        optimizer=None, loss=None, metrics=None, feature_cols=None,
+        label_cols=None, validation=None, callbacks=None,
+        batch_size=32, val_batch_size=None, epochs=1, verbose=1,
+        shuffle_buffer_size=None, partitions_per_process=4,
+        run_id=None, train_steps_per_epoch=None,
+        validation_steps_per_epoch=None, sample_weight_col=None,
+        gradient_compression=None, seed=0,
+    )
+
+
+class ModelParams(Params):
+    _params = dict(
+        model=None, feature_cols=None, label_cols=None,
+        output_cols=None, run_id=None,
+    )
+
+    def get_output_cols(self) -> List[str]:
+        out = self._get("output_cols")
+        if out:
+            return out
+        # Reference default: <label>__output.
+        return [f"{c}__output" for c in self._get("label_cols")]
+
+
+class HorovodEstimator(EstimatorParams):
+    """Base estimator; subclasses implement ``_remote_trainer()``
+    returning a picklable fn(run_id, rank-invariant args) run on every
+    worker, and ``_create_model(rank0_result)``."""
+
+    def fit(self, df, params: Optional[dict] = None) -> "HorovodModel":
+        if params:
+            return self.copy(params).fit(df)
+        backend = self._get_or_create_backend()
+        store = self.getStore()
+        num_parts = (backend.num_processes()
+                     * (self.getPartitionsPerProcess() or 1))
+        util.prepare_data(num_parts, store, df,
+                          feature_cols=self.getFeatureCols(),
+                          label_cols=self.getLabelCols(),
+                          validation=self.getValidation(),
+                          seed=self._get("seed"))
+        return self.fit_on_prepared_data(backend=backend)
+
+    def fit_on_prepared_data(self, backend: Optional[Backend] = None
+                             ) -> "HorovodModel":
+        """Train on shards already materialized in the Store (analog of
+        reference fit_on_parquet, spark/common/estimator.py:37-50)."""
+        backend = backend or self._get_or_create_backend()
+        store = self.getStore()
+        run_id = self.getRunId() or ("run_" + uuid.uuid4().hex[:12])
+        self.setRunId(run_id)
+        meta = util.read_metadata(store)
+        resume_state = None
+        if self._has_checkpoint(run_id):
+            resume_state = store.read(store.get_checkpoint_path(run_id))
+        trainer = self._remote_trainer(meta, resume_state, run_id)
+        results = backend.run(trainer)
+        return self._create_model(results[0], run_id)
+
+    # -- checkpoint/resume (reference: estimator.py:90-94,
+    #    torch/remote.py:139-141,190-200) ------------------------------
+    def _has_checkpoint(self, run_id: str) -> bool:
+        store = self.getStore()
+        path = store.get_checkpoint_path(run_id)
+        return path is not None and store.exists(path)
+
+    def _get_or_create_backend(self) -> Backend:
+        backend = self.getBackend()
+        if backend is None:
+            backend = LocalBackend(self.getNumProc() or 2,
+                                   verbose=self.getVerbose())
+        elif self.getNumProc() is not None:
+            raise ValueError('At most one of "backend" and "num_proc" '
+                             'may be specified')
+        return backend
+
+    def _remote_trainer(self, meta, resume_state, run_id):
+        raise NotImplementedError()
+
+    def _create_model(self, rank0_result, run_id) -> "HorovodModel":
+        raise NotImplementedError()
+
+
+class HorovodModel(ModelParams):
+    """Transformer: adds prediction columns to a DataFrame
+    (reference: spark/common/estimator.py:97-108)."""
+
+    def transform(self, df):
+        import numpy as np
+        pdf = util._to_pandas(df)
+        features = [np.asarray(pdf[c].tolist())
+                    for c in self.getFeatureCols()]
+        preds = self._predict(features)
+        out = pdf.copy()
+        for col, pred in zip(self.get_output_cols(), preds):
+            out[col] = list(np.asarray(pred))
+        return out
+
+    def _predict(self, features) -> List:
+        """Returns one prediction array per label column."""
+        raise NotImplementedError()
+
+
+def save_checkpoint(store, run_id: str, payload: bytes, epoch: int):
+    """Atomic per-epoch checkpoint + meta (epoch offset for resume)."""
+    store.write(store.get_checkpoint_path(run_id), payload)
+    store.write(os.path.join(store.get_run_path(run_id), CHECKPOINT_META),
+                json.dumps({"epoch": epoch}).encode())
+
+
+def checkpoint_epoch(store, run_id: str) -> int:
+    """Last completed epoch recorded for the run; -1 if none."""
+    path = os.path.join(store.get_run_path(run_id), CHECKPOINT_META)
+    if not store.exists(path):
+        return -1
+    return int(json.loads(store.read(path).decode())["epoch"])

@@ -1,0 +1,139 @@
+"""KerasEstimator: fit a Keras model to a DataFrame on distributed
+workers (reference: spark/keras/estimator.py — KerasEstimator /
+KerasModel over the shared HorovodEstimator machinery; remote trainer
+semantics from spark/keras/remote.py: broadcast initial state, shard
+the materialized data per rank, per-epoch checkpoint on rank 0,
+resume from the last checkpoint when re-fit with the same run_id).
+"""
+
+import io
+import os
+import tempfile
+from typing import List
+
+from .estimator import (HorovodEstimator, HorovodModel, checkpoint_epoch,
+                        save_checkpoint)
+from . import util
+
+
+def _model_to_bytes(model) -> bytes:
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.keras")
+        model.save(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+
+def _model_from_bytes(raw: bytes):
+    import keras
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.keras")
+        with open(path, "wb") as f:
+            f.write(raw)
+        return keras.models.load_model(path, compile=False)
+
+
+class KerasEstimator(HorovodEstimator):
+    """Usage mirrors the reference (spark/keras/estimator.py):
+
+        est = KerasEstimator(model=model, optimizer="sgd", loss="mse",
+                             feature_cols=["x"], label_cols=["y"],
+                             store=store, num_proc=2, epochs=4)
+        keras_model = est.fit(df)
+        pred_df = keras_model.transform(test_df)
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        if kwargs:
+            self.setParams(**kwargs)
+
+    def _remote_trainer(self, meta, resume_state, run_id):
+        import keras
+
+        store = self.getStore()
+        feature_cols = list(self.getFeatureCols())
+        label_cols = list(self.getLabelCols())
+        cols = feature_cols + label_cols
+        epochs = self.getEpochs()
+        batch_size = self.getBatchSize()
+        verbose = self.getVerbose()
+        user_callbacks = self.getCallbacks() or []
+        loss = self.getLoss()
+        metrics = self.getMetrics() or []
+        opt = self.getOptimizer() or "sgd"
+        opt_cfg = (keras.optimizers.serialize(opt)
+                   if not isinstance(opt, str) else opt)
+        model_bytes = (resume_state if resume_state is not None
+                       else _model_to_bytes(self.getModel()))
+        start_epoch = (checkpoint_epoch(store, run_id) + 1
+                       if resume_state is not None else 0)
+
+        def trainer():
+            import numpy as np
+            import keras
+            import horovod_tpu.keras as hvd
+
+            hvd.init()
+            rank, size = hvd.rank(), hvd.size()
+            model = _model_from_bytes(model_bytes)
+            optimizer = (keras.optimizers.get(opt_cfg)
+                         if isinstance(opt_cfg, str)
+                         else keras.optimizers.deserialize(opt_cfg))
+            optimizer = hvd.DistributedOptimizer(optimizer)
+            model.compile(optimizer=optimizer, loss=loss, metrics=metrics)
+
+            shard = util.data_shards(store, "train", rank, size, cols)
+            x = [shard[c] for c in feature_cols]
+            y = [shard[c] for c in label_cols]
+            x = x[0] if len(x) == 1 else x
+            y = y[0] if len(y) == 1 else y
+
+            cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]
+            if rank == 0:
+                class _Ckpt(keras.callbacks.Callback):
+                    def on_epoch_end(cb, epoch, logs=None):
+                        save_checkpoint(store, run_id,
+                                        _model_to_bytes(model), epoch)
+                cbs.append(_Ckpt())
+            cbs.extend(user_callbacks)
+
+            history = {}
+            if start_epoch < epochs:
+                h = model.fit(x, y, batch_size=batch_size,
+                              initial_epoch=start_epoch, epochs=epochs,
+                              verbose=verbose if rank == 0 else 0,
+                              shuffle=True, callbacks=cbs)
+                history = {k: [float(v) for v in vs]
+                           for k, vs in h.history.items()}
+            result = {"history": history, "start_epoch": start_epoch}
+            if rank == 0:
+                result["model"] = _model_to_bytes(model)
+            hvd.shutdown()
+            return result
+
+        return trainer
+
+    def _create_model(self, rank0_result, run_id) -> "KerasModel":
+        model = _model_from_bytes(rank0_result["model"])
+        m = KerasModel(model=model,
+                       feature_cols=self.getFeatureCols(),
+                       label_cols=self.getLabelCols(),
+                       run_id=run_id)
+        m.history = rank0_result["history"]
+        m.start_epoch = rank0_result["start_epoch"]
+        return m
+
+
+class KerasModel(HorovodModel):
+    def __init__(self, **kwargs):
+        super().__init__()
+        if kwargs:
+            self.setParams(**kwargs)
+
+    def _predict(self, features) -> List:
+        x = features[0] if len(features) == 1 else features
+        preds = self.getModel().predict(x, verbose=0)
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        return list(preds)

@@ -1,0 +1,146 @@
+"""TorchEstimator: fit a PyTorch model to a DataFrame on distributed
+workers (reference: spark/torch/estimator.py:91 — TorchEstimator /
+TorchModel; remote-trainer semantics from spark/torch/remote.py:36-200:
+restore from the last checkpoint state, broadcast parameters and
+optimizer state from rank 0, hvd.DistributedOptimizer training loop,
+per-epoch checkpoint through the Store).
+"""
+
+import io
+from typing import List
+
+from .estimator import (HorovodEstimator, HorovodModel, checkpoint_epoch,
+                        save_checkpoint)
+from . import util
+
+
+def _state_to_bytes(model, optimizer=None) -> bytes:
+    import torch
+    buf = io.BytesIO()
+    payload = {"model": model.state_dict()}
+    if optimizer is not None:
+        payload["optimizer"] = optimizer.state_dict()
+    torch.save(payload, buf)
+    return buf.getvalue()
+
+
+def _state_from_bytes(raw: bytes):
+    import torch
+    return torch.load(io.BytesIO(raw), weights_only=False)
+
+
+class TorchEstimator(HorovodEstimator):
+    """Usage mirrors the reference (spark/torch/estimator.py):
+
+        est = TorchEstimator(model=net, optimizer=torch.optim.SGD(
+                                 net.parameters(), lr=0.1),
+                             loss=torch.nn.MSELoss(),
+                             feature_cols=["x"], label_cols=["y"],
+                             store=store, num_proc=2, epochs=4)
+        torch_model = est.fit(df)
+        pred_df = torch_model.transform(test_df)
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        if kwargs:
+            self.setParams(**kwargs)
+
+    def _remote_trainer(self, meta, resume_state, run_id):
+        store = self.getStore()
+        feature_cols = list(self.getFeatureCols())
+        label_cols = list(self.getLabelCols())
+        cols = feature_cols + label_cols
+        epochs = self.getEpochs()
+        batch_size = self.getBatchSize()
+        seed = self._get("seed")
+        model = self.getModel()
+        loss_fn = self.getLoss()
+        opt = self.getOptimizer()
+        opt_cls = type(opt)
+        opt_defaults = dict(opt.defaults)
+        start_epoch = (checkpoint_epoch(store, run_id) + 1
+                       if resume_state is not None else 0)
+
+        def trainer():
+            import numpy as np
+            import torch
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            rank, size = hvd.rank(), hvd.size()
+            torch.manual_seed(seed)
+            net = model
+            optimizer = opt_cls(net.parameters(), **opt_defaults)
+            if resume_state is not None:
+                state = _state_from_bytes(resume_state)
+                net.load_state_dict(state["model"])
+                if "optimizer" in state:
+                    optimizer.load_state_dict(state["optimizer"])
+            optimizer = hvd.DistributedOptimizer(
+                optimizer, named_parameters=net.named_parameters())
+            hvd.broadcast_parameters(net.state_dict(), root_rank=0)
+            hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+            shard = util.data_shards(store, "train", rank, size, cols)
+
+            history = []
+            for epoch in range(start_epoch, epochs):
+                epoch_loss, steps = 0.0, 0
+                for batch in util.batches(
+                        shard, cols, batch_size,
+                        seed=seed + epoch, drop_remainder=False):
+                    bx = [torch.as_tensor(b).float()
+                          for b in batch[:len(feature_cols)]]
+                    by = [torch.as_tensor(b).float()
+                          for b in batch[len(feature_cols):]]
+                    optimizer.zero_grad()
+                    out = net(*bx)
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    loss = sum(loss_fn(o.squeeze(-1), t)
+                               for o, t in zip(outs, by))
+                    loss.backward()
+                    optimizer.step()
+                    epoch_loss += float(loss.detach())
+                    steps += 1
+                history.append(epoch_loss / max(steps, 1))
+                if rank == 0:
+                    save_checkpoint(
+                        store, run_id,
+                        _state_to_bytes(net, optimizer), epoch)
+            result = {"history": history, "start_epoch": start_epoch}
+            if rank == 0:
+                result["state"] = _state_to_bytes(net)
+            hvd.shutdown()
+            return result
+
+        return trainer
+
+    def _create_model(self, rank0_result, run_id) -> "TorchModel":
+        model = self.getModel()
+        state = _state_from_bytes(rank0_result["state"])
+        model.load_state_dict(state["model"])
+        m = TorchModel(model=model,
+                       feature_cols=self.getFeatureCols(),
+                       label_cols=self.getLabelCols(),
+                       run_id=run_id)
+        m.history = rank0_result["history"]
+        m.start_epoch = rank0_result["start_epoch"]
+        return m
+
+
+class TorchModel(HorovodModel):
+    def __init__(self, **kwargs):
+        super().__init__()
+        if kwargs:
+            self.setParams(**kwargs)
+
+    def _predict(self, features) -> List:
+        import torch
+        net = self.getModel()
+        net.eval()
+        with torch.no_grad():
+            xs = [torch.as_tensor(f).float() for f in features]
+            out = net(*xs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.squeeze(-1).numpy() for o in outs]

@@ -1,0 +1,133 @@
+"""Data materialization for the estimator API.
+
+The reference materializes the DataFrame to Parquet via Petastorm and
+reads it back with per-worker shard readers (reference:
+spark/common/util.py prepare_data/get_simple_meta_from_parquet).
+Petastorm is a GPU-era dependency; here the intermediate format is
+plain npz column shards — memory-mappable, numpy-native, and directly
+feedable to jit-compiled steps — with a JSON metadata sidecar.  The
+contract is the same: ``prepare_data`` writes train/val shards +
+metadata into the Store; ``data_shards`` gives a rank its partition.
+"""
+
+import glob
+import io
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+METADATA_FILE = "_metadata.json"
+
+
+def _to_pandas(df):
+    """Accept a pandas DataFrame or a pyspark DataFrame."""
+    if hasattr(df, "toPandas"):       # pyspark
+        return df.toPandas()
+    return df
+
+
+def prepare_data(num_partitions: int, store, df,
+                 feature_cols: Sequence[str], label_cols: Sequence[str],
+                 validation=None, seed: int = 0) -> Dict:
+    """Materialize ``df`` into npz shards under the store's train/val
+    paths and return the metadata dict (also written as a sidecar).
+
+    ``validation``: None, a float fraction for a random split, or a
+    column name whose truthy rows go to the validation set (reference:
+    spark/common/params.py validation semantics).
+    """
+    pdf = _to_pandas(df)
+    cols = list(feature_cols) + list(label_cols)
+    missing = [c for c in cols if c not in pdf.columns]
+    if missing:
+        raise ValueError(f"columns {missing} not in DataFrame "
+                         f"(has {list(pdf.columns)})")
+
+    arrays = {}
+    for c in cols:
+        v = np.asarray(pdf[c].tolist())
+        if v.dtype == np.float64:
+            v = v.astype(np.float32)
+        arrays[c] = v
+    n = len(pdf)
+
+    rng = np.random.RandomState(seed)
+    if validation is None:
+        val_mask = np.zeros(n, dtype=bool)
+    elif isinstance(validation, str):
+        val_mask = np.asarray(pdf[validation].tolist()).astype(bool)
+    else:
+        val_mask = rng.rand(n) < float(validation)
+
+    meta = {"columns": {}, "train_rows": 0, "val_rows": 0,
+            "num_partitions": num_partitions}
+    for split, mask, path in (
+            ("train", ~val_mask, store.get_train_data_path()),
+            ("val", val_mask, store.get_val_data_path())):
+        rows = int(mask.sum())
+        meta[f"{split}_rows"] = rows
+        if split == "val" and rows == 0:
+            continue
+        idx = np.nonzero(mask)[0]
+        rng.shuffle(idx)
+        parts = np.array_split(idx, num_partitions)
+        for i, part in enumerate(parts):
+            shard = {c: arrays[c][part] for c in cols}
+            buf = io.BytesIO()
+            np.savez(buf, **shard)
+            store.write(os.path.join(path, f"part-{i:05d}.npz"),
+                        buf.getvalue())
+    row_bytes = sum(arrays[c][0:1].nbytes for c in cols) if n else 0
+    meta["avg_row_size"] = row_bytes
+    for c in cols:
+        meta["columns"][c] = {"dtype": str(arrays[c].dtype),
+                              "shape": list(arrays[c].shape[1:])}
+    store.write(os.path.join(store.get_train_data_path(), METADATA_FILE),
+                json.dumps(meta).encode())
+    return meta
+
+
+def read_metadata(store) -> Dict:
+    raw = store.read(os.path.join(store.get_train_data_path(),
+                                  METADATA_FILE))
+    return json.loads(raw.decode())
+
+
+def data_shards(store, split: str, rank: int, size: int,
+                cols: Sequence[str]) -> Dict[str, np.ndarray]:
+    """Load this rank's partitions of a split, concatenated per column.
+
+    Partitions are assigned round-robin by rank (reference:
+    partitions_per_process assignment, spark/common/util.py)."""
+    path = (store.get_train_data_path() if split == "train"
+            else store.get_val_data_path())
+    parts = sorted(glob.glob(os.path.join(path, "part-*.npz")))
+    mine = parts[rank::size]
+    out: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+    for p in mine:
+        with np.load(io.BytesIO(store.read(p))) as z:
+            for c in cols:
+                out[c].append(z[c])
+    return {c: (np.concatenate(v) if v else np.zeros((0,)))
+            for c, v in out.items()}
+
+
+def batches(shard: Dict[str, np.ndarray], cols: Sequence[str],
+            batch_size: int, seed: int = 0, shuffle: bool = True,
+            drop_remainder: bool = True):
+    """Yield per-column batch tuples from a shard. Static batch shapes
+    keep XLA from recompiling per step (drop_remainder)."""
+    n = len(next(iter(shard.values()))) if shard else 0
+    if n == 0:
+        return
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    stop = n - batch_size + 1 if drop_remainder else n
+    if stop <= 0 and not drop_remainder:
+        stop = n
+    for s in range(0, max(stop, 0), batch_size):
+        sel = idx[s:s + batch_size]
+        yield tuple(shard[c][sel] for c in cols)

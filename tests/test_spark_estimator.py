@@ -1,0 +1,154 @@
+"""Estimator API tests (reference test strategy: test_spark.py runs the
+Estimator against local-mode Spark; here the LocalBackend stands in —
+same remote-trainer path, real multi-process workers, no cluster).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.spark.store import FilesystemStore
+from horovod_tpu.spark import util as sutil
+
+
+def _df(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n).astype(np.float32)
+    return pd.DataFrame({"x": x, "y": 2.0 * x + 0.5})
+
+
+# ---------------------------------------------------------------------------
+# unit: params / data prep
+# ---------------------------------------------------------------------------
+
+def test_params_accessors():
+    from horovod_tpu.spark.estimator import EstimatorParams
+    p = EstimatorParams()
+    p.setEpochs(7).setBatchSize(16).setFeatureCols(["x"])
+    assert p.getEpochs() == 7
+    assert p.getBatchSize() == 16
+    assert p.getFeatureCols() == ["x"]
+    with pytest.raises(ValueError):
+        p.setParams(not_a_param=1)
+    dup = p.copy({"epochs": 9})
+    assert dup.getEpochs() == 9 and p.getEpochs() == 7
+
+
+def test_prepare_data_and_shards(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+    meta = sutil.prepare_data(4, store, _df(100), feature_cols=["x"],
+                              label_cols=["y"], validation=0.2)
+    assert meta["train_rows"] + meta["val_rows"] == 100
+    assert meta["val_rows"] > 0
+    assert meta["columns"]["x"]["dtype"] == "float32"
+    # Round-trip through per-rank shards covers every training row once.
+    total = 0
+    for rank in range(2):
+        shard = sutil.data_shards(store, "train", rank, 2, ["x", "y"])
+        np.testing.assert_allclose(2.0 * shard["x"] + 0.5, shard["y"],
+                                   rtol=1e-5)
+        total += len(shard["x"])
+    assert total == meta["train_rows"]
+    # metadata sidecar readable
+    assert sutil.read_metadata(store)["train_rows"] == meta["train_rows"]
+
+
+def test_batches_static_shapes(tmp_path):
+    shard = {"x": np.arange(10.0), "y": np.arange(10.0)}
+    got = list(sutil.batches(shard, ["x", "y"], 4))
+    assert all(b[0].shape == (4,) for b in got)      # drop_remainder
+    got = list(sutil.batches(shard, ["x", "y"], 4, drop_remainder=False))
+    assert sum(len(b[0]) for b in got) == 10
+
+
+def test_validation_column_split(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+    df = _df(20)
+    df["is_val"] = [i < 5 for i in range(20)]
+    meta = sutil.prepare_data(2, store, df, feature_cols=["x"],
+                              label_cols=["y"], validation="is_val")
+    assert meta["val_rows"] == 5 and meta["train_rows"] == 15
+
+
+# ---------------------------------------------------------------------------
+# e2e: torch estimator over 2 local worker processes
+# ---------------------------------------------------------------------------
+
+def test_torch_estimator_fit_transform_resume(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    store = FilesystemStore(str(tmp_path))
+    net = torch.nn.Linear(1, 1)
+    est = TorchEstimator(
+        model=net,
+        optimizer=torch.optim.SGD(net.parameters(), lr=0.5),
+        loss=torch.nn.MSELoss(),
+        feature_cols=["x"], label_cols=["y"],
+        store=store, num_proc=2, epochs=3, batch_size=8,
+        run_id="torchrun", verbose=0)
+
+    df = _df(64)
+    df["x"] = df["x"].apply(lambda v: [v])   # feature as 1-vector
+    model = est.fit(df)
+    assert model.start_epoch == 0
+    assert len(model.history) == 3
+    assert model.history[-1] < model.history[0]
+
+    # transform: prediction column with default <label>__output name
+    out = model.transform(df.head(8))
+    assert "y__output" in out.columns
+    pred = np.asarray(out["y__output"].tolist())
+    np.testing.assert_allclose(pred, np.asarray(out["y"].tolist()),
+                               atol=0.5)
+
+    # resume: same run_id picks up at epoch 3
+    from horovod_tpu.spark.estimator import checkpoint_epoch
+    assert checkpoint_epoch(store, "torchrun") == 2
+    est2 = TorchEstimator(
+        model=torch.nn.Linear(1, 1),
+        optimizer=torch.optim.SGD(net.parameters(), lr=0.5),
+        loss=torch.nn.MSELoss(),
+        feature_cols=["x"], label_cols=["y"],
+        store=store, num_proc=2, epochs=5, batch_size=8,
+        run_id="torchrun", verbose=0)
+    model2 = est2.fit_on_prepared_data()
+    assert model2.start_epoch == 3
+    assert len(model2.history) == 2          # epochs 3..4 only
+    assert checkpoint_epoch(store, "torchrun") == 4
+
+
+# ---------------------------------------------------------------------------
+# e2e: keras estimator over 2 local worker processes
+# ---------------------------------------------------------------------------
+
+def test_keras_estimator_fit_transform_resume(tmp_path):
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark.keras import KerasEstimator
+
+    store = FilesystemStore(str(tmp_path))
+    model = keras.Sequential([keras.layers.Input(shape=(1,)),
+                              keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model, optimizer="sgd", loss="mse",
+        feature_cols=["x"], label_cols=["y"],
+        store=store, num_proc=2, epochs=2, batch_size=8,
+        run_id="kerasrun", verbose=0)
+
+    df = _df(64)
+    fitted = est.fit(df)
+    assert fitted.start_epoch == 0
+    assert len(fitted.history["loss"]) == 2
+
+    out = fitted.transform(df.head(8))
+    assert "y__output" in out.columns
+
+    # resume from the epoch-1 checkpoint
+    est2 = KerasEstimator(
+        model=None, optimizer="sgd", loss="mse",
+        feature_cols=["x"], label_cols=["y"],
+        store=store, num_proc=2, epochs=4, batch_size=8,
+        run_id="kerasrun", verbose=0)
+    fitted2 = est2.fit_on_prepared_data()
+    assert fitted2.start_epoch == 2
+    assert len(fitted2.history["loss"]) == 2
