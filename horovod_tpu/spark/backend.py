@@ -14,6 +14,7 @@ import pickle
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Callable, List, Optional
 
 
@@ -105,6 +106,8 @@ class LocalBackend(Backend):
                     "HVD_ESTIMATOR_FN": fn_path,
                     "HVD_ESTIMATOR_OUT": out_path,
                 })
+                if extra_env:
+                    env.update(extra_env)
                 if nproc > 1 and not self._use_tpu:
                     # One TPU chip cannot be shared by N processes;
                     # multi-proc local training rides the CPU data plane.
@@ -116,9 +119,13 @@ class LocalBackend(Backend):
                     stderr=subprocess.STDOUT))
             failures = []
             tails = []
+            # One shared deadline: a wedged worker set must fail after
+            # ~timeout total, not nproc * timeout.
+            deadline = time.monotonic() + self._timeout
             for rank, p in enumerate(procs):
                 try:
-                    out, _ = p.communicate(timeout=self._timeout)
+                    out, _ = p.communicate(
+                        timeout=max(1.0, deadline - time.monotonic()))
                 except subprocess.TimeoutExpired:
                     p.kill()
                     out, _ = p.communicate()

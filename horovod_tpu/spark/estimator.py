@@ -111,11 +111,17 @@ class HorovodEstimator(EstimatorParams):
 
 class HorovodModel(ModelParams):
     """Transformer: adds prediction columns to a DataFrame
-    (reference: spark/common/estimator.py:97-108)."""
+    (reference: spark/common/estimator.py:97-108).  pyspark DataFrames
+    transform distributedly via ``mapInPandas`` and stay Spark
+    DataFrames; pandas input predicts in-process."""
 
     def transform(self, df):
+        if hasattr(df, "mapInPandas"):
+            return self._transform_spark(df)
+        return self._transform_pandas(df)
+
+    def _transform_pandas(self, pdf):
         import numpy as np
-        pdf = util._to_pandas(df)
         features = [np.asarray(pdf[c].tolist())
                     for c in self.getFeatureCols()]
         preds = self._predict(features)
@@ -123,6 +129,39 @@ class HorovodModel(ModelParams):
         for col, pred in zip(self.get_output_cols(), preds):
             out[col] = list(np.asarray(pred))
         return out
+
+    def _transform_spark(self, df):
+        """Distributed transform: one model instance per task, no
+        driver-side collect (reference transforms via a UDF,
+        spark/torch/estimator.py TorchModel._transform)."""
+        import numpy as np
+        from pyspark.sql.types import (ArrayType, FloatType, StructField,
+                                       StructType)
+        # Output schema: input schema + one field per prediction
+        # column; shape probed on a single driver-side row.
+        sample = df.limit(1).toPandas()
+        probe = self._transform_pandas(sample)
+        fields = list(df.schema.fields)
+        for col in self.get_output_cols():
+            val = np.asarray(probe[col].tolist())
+            typ = FloatType()
+            for _ in range(max(val.ndim - 1, 0)):   # nest per row dim
+                typ = ArrayType(typ)
+            fields.append(StructField(col, typ))
+        schema = StructType(fields)
+        transform_pandas = self._transform_pandas
+        out_cols = self.get_output_cols()
+
+        def fn(iterator):
+            for pdf in iterator:
+                out = transform_pandas(pdf)
+                for col in out_cols:
+                    vals = np.asarray(out[col].tolist()).astype(float)
+                    out[col] = (vals if vals.ndim == 1
+                                else list(vals.tolist()))
+                yield out
+
+        return df.mapInPandas(fn, schema=schema)
 
     def _predict(self, features) -> List:
         """Returns one prediction array per label column."""

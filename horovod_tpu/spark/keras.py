@@ -6,8 +6,8 @@ the materialized data per rank, per-epoch checkpoint on rank 0,
 resume from the last checkpoint when re-fit with the same run_id).
 """
 
-import io
 import os
+import pickle
 import tempfile
 from typing import List
 
@@ -64,10 +64,18 @@ class KerasEstimator(HorovodEstimator):
         opt = self.getOptimizer() or "sgd"
         opt_cfg = (keras.optimizers.serialize(opt)
                    if not isinstance(opt, str) else opt)
-        model_bytes = (resume_state if resume_state is not None
-                       else _model_to_bytes(self.getModel()))
-        start_epoch = (checkpoint_epoch(store, run_id) + 1
-                       if resume_state is not None else 0)
+        # Checkpoint payload: model bytes + optimizer slot variables
+        # (momentum/Adam moments, iteration counter) so a resumed run
+        # continues the optimizer trajectory, matching the torch
+        # sibling (reference: spark/torch/remote.py:139-141).
+        if resume_state is not None:
+            ckpt = pickle.loads(resume_state)
+            model_bytes, opt_vars = ckpt["model"], ckpt["opt_vars"]
+            start_epoch = checkpoint_epoch(store, run_id) + 1
+        else:
+            model_bytes = _model_to_bytes(self.getModel())
+            opt_vars = None
+            start_epoch = 0
 
         def trainer():
             import numpy as np
@@ -82,6 +90,21 @@ class KerasEstimator(HorovodEstimator):
                          else keras.optimizers.deserialize(opt_cfg))
             optimizer = hvd.DistributedOptimizer(optimizer)
             model.compile(optimizer=optimizer, loss=loss, metrics=metrics)
+            if opt_vars is not None:
+                optimizer.build(model.trainable_variables)
+                live = list(optimizer.variables)
+                if len(live) == len(opt_vars) and all(
+                        tuple(v.shape) == tuple(s.shape)
+                        for v, s in zip(live, opt_vars)):
+                    for var, val in zip(live, opt_vars):
+                        var.assign(val)
+                else:
+                    import warnings
+                    warnings.warn(
+                        "checkpointed optimizer state does not match "
+                        "the current optimizer (changed optimizer "
+                        "between resumes?); continuing with fresh "
+                        "optimizer slots")
 
             shard = util.data_shards(store, "train", rank, size, cols)
             x = [shard[c] for c in feature_cols]
@@ -93,8 +116,12 @@ class KerasEstimator(HorovodEstimator):
             if rank == 0:
                 class _Ckpt(keras.callbacks.Callback):
                     def on_epoch_end(cb, epoch, logs=None):
-                        save_checkpoint(store, run_id,
-                                        _model_to_bytes(model), epoch)
+                        payload = pickle.dumps({
+                            "model": _model_to_bytes(model),
+                            "opt_vars": [v.numpy()
+                                         for v in optimizer.variables],
+                        })
+                        save_checkpoint(store, run_id, payload, epoch)
                 cbs.append(_Ckpt())
             cbs.extend(user_callbacks)
 

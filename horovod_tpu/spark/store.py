@@ -36,6 +36,13 @@ class Store:
     def write(self, path: str, data: bytes):
         raise NotImplementedError()
 
+    def list(self, path: str, pattern: str) -> list:
+        """Paths under ``path`` matching the glob ``pattern``."""
+        raise NotImplementedError()
+
+    def delete(self, path: str):
+        raise NotImplementedError()
+
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         return FilesystemStore(prefix_path, *args, **kwargs)
@@ -95,6 +102,10 @@ class FilesystemStore(Store):
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)
+
+    def list(self, path: str, pattern: str) -> list:
+        import glob
+        return sorted(glob.glob(os.path.join(path, pattern)))
 
     def delete(self, path: str):
         if os.path.isdir(path):

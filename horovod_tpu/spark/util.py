@@ -10,7 +10,6 @@ contract is the same: ``prepare_data`` writes train/val shards +
 metadata into the Store; ``data_shards`` gives a rank its partition.
 """
 
-import glob
 import io
 import json
 import os
@@ -66,6 +65,10 @@ def prepare_data(num_partitions: int, store, df,
     for split, mask, path in (
             ("train", ~val_mask, store.get_train_data_path()),
             ("val", val_mask, store.get_val_data_path())):
+        # Clear any previously materialized shards: a re-fit with fewer
+        # partitions must not leave stale part files that data_shards
+        # would silently mix into training.
+        store.delete(path)
         rows = int(mask.sum())
         meta[f"{split}_rows"] = rows
         if split == "val" and rows == 0:
@@ -103,7 +106,7 @@ def data_shards(store, split: str, rank: int, size: int,
     partitions_per_process assignment, spark/common/util.py)."""
     path = (store.get_train_data_path() if split == "train"
             else store.get_val_data_path())
-    parts = sorted(glob.glob(os.path.join(path, "part-*.npz")))
+    parts = sorted(store.list(path, "part-*.npz"))
     mine = parts[rank::size]
     out: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
     for p in mine:

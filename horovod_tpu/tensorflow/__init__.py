@@ -7,13 +7,17 @@ _make_allreduce_grads_fn :334-381, DistributedOptimizer :568-689,
 DistributedGradientTape :691+; op wrappers tensorflow/mpi_ops.py).
 
 TPU-native design note: the hot path of this framework is JAX/XLA
-(:mod:`horovod_tpu.jax`, :mod:`horovod_tpu.training`); the TF binding
-stages tensors through host memory into the same background runtime —
-the analog of the reference's ``*CudaOnCPU`` staged variants
-(torch/mpi_ops_v2.cc:93-127).  Inside ``tf.function`` graphs the ops
-run as ``tf.py_function`` nodes, so rank/size are read at execution
-time (which is what elastic graph reuse needs, reference
-tensorflow/mpi_ops.py:327-391).
+(:mod:`horovod_tpu.jax`, :mod:`horovod_tpu.training`).  The TF binding
+has two data paths: eager ops stage tensors through host memory into
+the negotiated background runtime (the analog of the reference's
+``*CudaOnCPU`` staged variants, torch/mpi_ops_v2.cc:93-127), while ops
+traced inside ``tf.function`` lower to TensorFlow's native in-graph
+collectives (:mod:`.graph_ops` — no per-step ``tf.py_function`` host
+hop, the analog of the reference's AsyncOpKernels,
+tensorflow/mpi_ops.cc:374-428), falling back to ``tf.py_function``
+when the collective cluster is unavailable.  The ``*_op`` scalar
+queries stay execution-time reads, which is what elastic graph reuse
+needs (reference tensorflow/mpi_ops.py:327-391).
 """
 
 import warnings
@@ -32,6 +36,9 @@ from ..common.basics import (Adasum, Average, Max, Min, Product, Sum,
                              size, start_timeline, stop_timeline)
 from .. import ops as _ops
 from ..ops.compression import Compression
+from ..ops.eager import _resolve_op
+from . import graph_ops as _graph
+from .graph_ops import enable_graph_collectives
 
 __all__ = [
     "init", "shutdown", "rank", "size", "local_rank", "local_size",
@@ -47,8 +54,22 @@ __all__ = [
     "broadcast_variables", "broadcast_global_variables",
     "broadcast_object", "allgather_object",
     "DistributedOptimizer", "DistributedGradientTape",
-    "SyncBatchNormalization", "elastic",
+    "SyncBatchNormalization", "elastic", "enable_graph_collectives",
 ]
+
+
+_basics_init = init
+
+
+def init(comm=None, process_sets=None):
+    """hvd.init plus best-effort TF graph-collective setup. The enable
+    attempt is unconditional on every rank (a unanimous-feasibility
+    vote inside decides; see graph_ops) so ranks cannot diverge between
+    the compiled and py_function paths."""
+    result = _basics_init(comm=comm, process_sets=process_sets)
+    if basics.size() > 1:
+        enable_graph_collectives()
+    return result
 
 
 def _to_numpy(tensor) -> np.ndarray:
@@ -105,6 +126,13 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
                              process_set=process_set)
         return np.asarray(compression.decompress(out, ctx))
 
+    if not _eager(tensor) and compression is Compression.none:
+        resolved = _resolve_op(op, average)
+        if resolved in _graph._MERGE_FINAL and \
+                _graph._ctx.usable(process_set, tensor.dtype):
+            return _graph.allreduce_graph(
+                tensor, resolved, prescale_factor, postscale_factor,
+                process_set)
     return _run_op(_fn, [tensor],
                    tensor.dtype if hasattr(tensor, "dtype") else None)
 
@@ -131,12 +159,22 @@ def grouped_allreduce(tensors, average=None, compression=Compression.none,
     if all(_eager(t) for t in tensors):
         outs = _fn(*[_to_numpy(t) for t in tensors])
         return [tf.convert_to_tensor(o) for o in outs]
+    resolved = _resolve_op(op, average)
+    if compression is Compression.none and \
+            resolved in _graph._MERGE_FINAL and all(
+            _graph._ctx.usable(process_set, t.dtype) for t in tensors):
+        return _graph.grouped_allreduce_graph(
+            list(tensors), resolved, prescale_factor, postscale_factor,
+            process_set)
     return list(tf.py_function(
         lambda *ts: _fn(*[t.numpy() for t in ts]), list(tensors),
         [t.dtype for t in tensors]))
 
 
 def allgather(tensor, name=None, process_set=global_process_set):
+    if not _eager(tensor) and _graph._ctx.usable(process_set,
+                                                tensor.dtype):
+        return _graph.allgather_graph(tensor, process_set)
     return _run_op(
         lambda a: np.asarray(_ops.allgather(a, name=name,
                                             process_set=process_set)),
@@ -145,6 +183,9 @@ def allgather(tensor, name=None, process_set=global_process_set):
 
 def broadcast(tensor, root_rank, name=None,
               process_set=global_process_set):
+    if not _eager(tensor) and _graph._ctx.usable(process_set,
+                                                 tensor.dtype):
+        return _graph.broadcast_graph(tensor, root_rank, process_set)
     return _run_op(
         lambda a: np.asarray(_ops.broadcast(a, root_rank, name=name,
                                             process_set=process_set)),
@@ -166,6 +207,15 @@ def alltoall(tensor, splits=None, name=None,
 
 def reducescatter(tensor, op=None, name=None,
                   process_set=global_process_set):
+    # CollectiveReduceScatterV2 needs dim 0 divisible by group size;
+    # the eager/XLA path implements the uneven-split convention, so
+    # only lower when divisibility is statically certain.
+    dim0 = tensor.shape[0] if tensor.shape.rank else None
+    if not _eager(tensor) and op in (None, Sum, Average) and \
+            dim0 is not None and \
+            dim0 % max(process_set.size(), 1) == 0 and \
+            _graph._ctx.usable(process_set, tensor.dtype):
+        return _graph.reducescatter_graph(tensor, op or Sum, process_set)
     return _run_op(
         lambda a: np.asarray(_ops.reducescatter(a, name=name, op=op,
                                                 process_set=process_set)),
