@@ -19,6 +19,7 @@ Deltas from the reference:
     the launcher provides HOROVOD_CONTROLLER_ADDR.
 """
 
+import json
 import logging
 import os
 import queue
@@ -49,6 +50,7 @@ _MAGIC_RESP = b"RS"     # coord→worker: full response list
 _MAGIC_HITS = b"CH"     # worker→coord: cache-hit bit list (fast path)
 _MAGIC_CACHE = b"CB"    # coord→worker: fused batches of cache bits
 _MAGIC_EVICT = b"EV"    # coord→worker: evicted cache bits
+_MAGIC_PARAMS = b"PA"   # coord→worker: autotuned runtime parameters
 
 
 def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
@@ -100,6 +102,10 @@ class CoordinatorServer:
         self.param_manager = param_manager
         if param_manager is not None:
             param_manager.fusion_threshold_bytes = fusion_threshold
+        # Last PA-frame-synced categorical params version (-1 = stock
+        # configuration, nothing announced yet).
+        self._synced_params_version = -1
+        self._synced_params = None
         self._table = MessageTable()
         self._seen = 0
         self._departed = 0
@@ -186,6 +192,16 @@ class CoordinatorServer:
             rank = struct.unpack("<i", frame[1])[0]
             with self._lock:
                 self._conns[rank] = conn
+                # Late joiners (elastic re-rendezvous) must start from
+                # the currently announced parameters, and they see the
+                # PA frame before any response frame — the same stream
+                # position every other worker saw it at.
+                if self._synced_params is not None:
+                    try:
+                        _send_frame(conn, _MAGIC_PARAMS,
+                                    self._synced_params)
+                    except OSError:
+                        pass
             with self._departed_cond:
                 self._seen += 1
                 self._departed_cond.notify_all()
@@ -465,10 +481,37 @@ class CoordinatorServer:
                           for fr in fused for n in fr.tensor_names)
         else:
             self._flush_evictions_locked()
-        if self.param_manager is not None and self.param_manager.active:
-            self.param_manager.record_step(nbytes)
-            self.fusion_threshold = \
-                self.param_manager.fusion_threshold_bytes
+        if self.param_manager is not None:
+            if self.param_manager.active:
+                self.param_manager.record_step(nbytes)
+                self.fusion_threshold = \
+                    self.param_manager.fusion_threshold_bytes
+            if self.param_manager.params_version != \
+                    self._synced_params_version:
+                self._sync_tuned_params_locked()
+
+    def _sync_tuned_params_locked(self):
+        """Announce the autotuner's categorical knobs to every worker
+        via a PA frame (the reference broadcasts tuned params through
+        the controller, controller.cc:39-53).  Broadcast under the
+        server lock positions the frame identically in every worker's
+        response stream, so all ranks flip between the same two fused
+        batches."""
+        pm = self.param_manager
+        params = pm.categorical_params
+        self._synced_params_version = pm.params_version
+        cache_on = bool(params["cache"])
+        if cache_on != self._cache.enabled:
+            self._pending_evictions.extend(
+                self._cache.set_enabled(cache_on))
+            self._flush_evictions_locked()
+        payload = json.dumps({
+            "hierarchical": bool(params["hierarchical"]),
+            "cache": cache_on,
+            "fusion": int(self.fusion_threshold),
+        }).encode()
+        self._synced_params = payload
+        self._broadcast_frame_locked(_MAGIC_PARAMS, payload)
 
     def _assign_cache_bits(self, fused: List[Response],
                            sig_by_name: Dict[str, tuple]):
@@ -593,8 +636,11 @@ class NetworkController(Controller):
         self.cache = WorkerResponseCache(state.knobs.cache_capacity)
         self._sent_sigs: Dict[str, tuple] = {}
         self.stats = {"rq_frames": 0, "ch_frames": 0, "rs_frames": 0,
-                      "cb_frames": 0, "ev_frames": 0,
+                      "cb_frames": 0, "ev_frames": 0, "pa_frames": 0,
                       "bytes_sent": 0, "bytes_recv": 0}
+        # PA params stashed until the batches received before them have
+        # executed (applied at the next compute_response_list entry).
+        self._pending_params: Optional[dict] = None
         addr = os.environ.get(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
@@ -637,7 +683,10 @@ class NetworkController(Controller):
         """Prefer the native C++ coordinator (horovod_tpu/native); fall
         back to the Python CoordinatorServer.  The Python server is
         also used when a timeline is active (negotiation spans are
-        recorded coordinator-side)."""
+        recorded coordinator-side) and while the autotuner runs (the
+        parameter manager scores real per-round byte counts in-line and
+        announces categorical knobs via PA frames — higher-fidelity
+        than the native counter-polling path it replaces)."""
         allow_ephemeral = self._rendezvous_client() is not None
         stall_warn = 0.0 if state.knobs.stall_check_disable else \
             state.knobs.stall_warning_time_s
@@ -648,7 +697,13 @@ class NetworkController(Controller):
         strict_native = os.environ.get(
             "HOROVOD_TPU_NATIVE", "").strip().lower() in ("1", "true",
                                                           "on", "yes")
-        if state.timeline is None:
+        if strict_native and param_manager is not None:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_AUTOTUNE=1: the autotuner requires the Python "
+                "coordinator (in-line scoring + PA parameter frames). "
+                "Unset one of the two.")
+        if state.timeline is None and param_manager is None:
             try:
                 from ..native import NativeCoordinatorServer, available
                 if strict_native and not available():
@@ -777,6 +832,15 @@ class NetworkController(Controller):
                 self.stats["ev_frames"] += 1
                 self.cache.evict_bits(unpack_bits(payload))
                 continue
+            if magic == _MAGIC_PARAMS:
+                self.stats["pa_frames"] += 1
+                # Queued as an in-stream marker: the runtime applies it
+                # exactly between the batches it arrived between, so
+                # every worker flips knobs at the same logical point
+                # (hierarchical on/off changes the compiled collective
+                # program — a half-flipped world would hang).
+                self._recv_buf.put(("PA", json.loads(payload.decode())))
+                continue
             self.stats["rs_frames"] += 1
             responses, _ = unpack_response_list(payload)
             self._seed_cache(responses)
@@ -861,15 +925,35 @@ class NetworkController(Controller):
                 from .exceptions import HorovodInternalError
                 raise HorovodInternalError(
                     f"could not reach the coordinator: {e}") from e
+        if self._pending_params is not None:
+            # Everything returned before the PA marker has executed by
+            # now (the runtime performs responses before calling back).
+            self._apply_params(self._pending_params)
+            self._pending_params = None
         responses: List[Response] = []
         try:
             # Block briefly: either a batch arrives or the cycle ends.
-            responses.extend(self._recv_buf.get(timeout=0.005))
+            item = self._recv_buf.get(timeout=0.005)
             while True:
-                responses.extend(self._recv_buf.get_nowait())
+                if isinstance(item, tuple) and item[0] == "PA":
+                    if responses:
+                        # Batches before the marker must execute first.
+                        self._pending_params = item[1]
+                        break
+                    self._apply_params(item[1])
+                else:
+                    responses.extend(item)
+                item = self._recv_buf.get_nowait()
         except queue.Empty:
             pass
         return responses, []
+
+    def _apply_params(self, params: dict):
+        """Adopt autotuned parameters announced by the coordinator
+        (reference: Controller::SynchronizeParameters)."""
+        if "hierarchical" in params:
+            self.state.knobs.hierarchical_allreduce = \
+                bool(params["hierarchical"])
 
     def shutdown(self):
         self._closing = True

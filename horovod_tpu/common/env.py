@@ -71,6 +71,15 @@ def env_bool(name: str, default: bool = False) -> bool:
     return v.strip().lower() in _TRUE
 
 
+def env_bool_opt(name: str):
+    """Tri-state env bool: None when unset (lets the runtime pick a
+    topology-dependent default)."""
+    v = os.environ.get(name)
+    if v is None:
+        return None
+    return v.strip().lower() in _TRUE
+
+
 def env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     try:
@@ -126,7 +135,13 @@ class Knobs:
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     cycle_time_ms: float = 1.0
     cache_capacity: int = 1024
-    hierarchical_allreduce: bool = False
+    # None = auto: hierarchical allreduce defaults ON when each
+    # process drives several chips (the all-local-chips layout; a flat
+    # world-mesh eager op would idle all but one chip per host), OFF
+    # for one-chip-per-process rigs. Explicit env/autotune settings
+    # override (reference gates it behind HOROVOD_HIERARCHICAL_ALLREDUCE
+    # unconditionally, operations.cc:441-534).
+    hierarchical_allreduce: Optional[bool] = None
     hierarchical_allgather: bool = False
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -149,7 +164,7 @@ class Knobs:
                 HOROVOD_FUSION_THRESHOLD, 64 * 1024 * 1024),
             cycle_time_ms=env_float(HOROVOD_CYCLE_TIME, 1.0),
             cache_capacity=env_int(HOROVOD_CACHE_CAPACITY, 1024),
-            hierarchical_allreduce=env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allreduce=env_bool_opt(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             autotune=env_bool(HOROVOD_AUTOTUNE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG),

@@ -224,10 +224,24 @@ class CoordinatorCache:
         # bit -> (name, sig, sizes, group_id) for recently evicted bits
         self._tombstones: "OrderedDict[int, tuple]" = OrderedDict()
         self._next_bit = 0
+        self._disabled = False
 
     @property
     def enabled(self) -> bool:
-        return self.capacity > 0
+        return self.capacity > 0 and not self._disabled
+
+    def set_enabled(self, flag: bool) -> List[int]:
+        """Runtime toggle (autotuner cache on/off).  Disabling evicts
+        every live entry; the returned bits must be EV-broadcast so
+        worker caches drain through the normal protocol."""
+        evicted: List[int] = []
+        if not flag and not self._disabled:
+            for name in list(self._entries):
+                bit = self.evict_name(name)
+                if bit is not None:
+                    evicted.append(bit)
+        self._disabled = not flag
+        return evicted
 
     def get(self, name: str) -> Optional[list]:
         return self._entries.get(name)

@@ -65,6 +65,7 @@ class XlaMeshBackend(Backend):
         self.state = state
         self.size = state.rank_info.size
         self.rank = state.rank_info.rank
+        self.stats = {"hierarchical_allreduces": 0, "flat_allreduces": 0}
         devices = jax.devices()
         by_proc = {}
         for d in devices:
@@ -121,8 +122,14 @@ class XlaMeshBackend(Backend):
             self._hier_nlocal = ri.local_size
 
     def hierarchical_active(self, ps_ranks=()) -> bool:
-        return (self.state.knobs.hierarchical_allreduce and
-                self._hier is not None and not ps_ranks)
+        knob = self.state.knobs.hierarchical_allreduce
+        if knob is None:
+            # Auto default: the ``device`` topology means this process
+            # drives several chips — the flat world-mesh op would use
+            # one chip per process and idle the rest, so the sharded
+            # hierarchical layout is the default there.
+            knob = self._hier_kind == "device"
+        return bool(knob) and self._hier is not None and not ps_ranks
 
     # ------------------------------------------------------------------
     # process-set sub-meshes
@@ -195,8 +202,10 @@ class XlaMeshBackend(Backend):
                   ps_ranks=()):
         if self.hierarchical_active(ps_ranks) and \
                 reduce_op in ("Sum", "Average"):
+            self.stats["hierarchical_allreduces"] += 1
             return self._hierarchical_allreduce(
                 arrays, reduce_op, prescale, postscale)
+        self.stats["flat_allreduces"] += 1
         mesh, gsize, _ = self._group(tuple(ps_ranks))
         globals_, meta = [], []
         for x in arrays:

@@ -69,8 +69,13 @@ class KerasEstimator(HorovodEstimator):
         # continues the optimizer trajectory, matching the torch
         # sibling (reference: spark/torch/remote.py:139-141).
         if resume_state is not None:
-            ckpt = pickle.loads(resume_state)
-            model_bytes, opt_vars = ckpt["model"], ckpt["opt_vars"]
+            try:
+                ckpt = pickle.loads(resume_state)
+                model_bytes, opt_vars = ckpt["model"], ckpt["opt_vars"]
+            except Exception:
+                # Legacy/model-only checkpoint: raw .keras archive
+                # bytes with no optimizer slots.
+                model_bytes, opt_vars = resume_state, None
             start_epoch = checkpoint_epoch(store, run_id) + 1
         else:
             model_bytes = _model_to_bytes(self.getModel())

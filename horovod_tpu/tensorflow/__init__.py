@@ -172,8 +172,15 @@ def grouped_allreduce(tensors, average=None, compression=Compression.none,
 
 
 def allgather(tensor, name=None, process_set=global_process_set):
-    if not _eager(tensor) and _graph._ctx.usable(process_set,
-                                                tensor.dtype):
+    # CollectiveGatherV2 requires equal shapes on every rank; the
+    # negotiated path supports ragged first dims (xla_ops allgather
+    # takes per-rank sizes). A dynamic first dim at trace time (e.g.
+    # the IndexedSlices sparse path, where slice counts are
+    # data-dependent) therefore stays on the negotiated path.
+    static_dim0 = (getattr(tensor, "shape", None) is not None and
+                   tensor.shape.rank and tensor.shape[0] is not None)
+    if not _eager(tensor) and static_dim0 and \
+            _graph._ctx.usable(process_set, tensor.dtype):
         return _graph.allgather_graph(tensor, process_set)
     return _run_op(
         lambda a: np.asarray(_ops.allgather(a, name=name,

@@ -99,6 +99,10 @@ class _GraphCollectives:
         size, rank = basics.size(), basics.rank()
         if size == 1:
             raise RuntimeError("single process")
+        if basics._state().knobs.elastic:
+            raise RuntimeError(
+                "graph collectives are incompatible with elastic runs "
+                "(group sizes are baked into traced graphs)")
         # The enable decision must be unanimous: a rank whose TF
         # context is already live cannot join the cluster (enabling
         # would invalidate its existing tensors), a rank with the kill
@@ -170,6 +174,12 @@ class _GraphCollectives:
     # -- key management --------------------------------------------------
     def usable(self, process_set, dtype=None) -> bool:
         if not self.env_enabled:
+            return False
+        # Elastic runs resize the world; traced graphs bake group_size
+        # and the gRPC cluster at trace time, so reused graphs would
+        # execute stale collectives after a resize. Elastic stays on
+        # the execution-time (py_function) path.
+        if basics.is_initialized() and basics._state().knobs.elastic:
             return False
         if dtype is not None and tf.as_dtype(dtype) not in _SUPPORTED_DTYPES:
             return False

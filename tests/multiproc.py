@@ -76,7 +76,8 @@ def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
         })
         if extra_env:
             env.update(extra_env)
-        env.pop("XLA_FLAGS", None)
+        if not (extra_env and "XLA_FLAGS" in extra_env):
+            env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))

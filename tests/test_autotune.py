@@ -90,3 +90,62 @@ print("AUTOTUNE OK", RANK)
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,")
     assert len(lines) >= 3
+
+
+def test_autotune_flips_hierarchical_and_cache():
+    """The categorical search must explore hierarchical on/off and
+    cache on/off, announce flips via PA frames, and keep every rank's
+    data plane consistent (reference parameter_manager.h:186-220
+    categorical params + SynchronizeParameters broadcast)."""
+    from multiproc import assert_all_ok, run_workers
+    body = """
+from horovod_tpu.common import basics
+state = basics._state()
+for i in range(120):
+    out = hvd.allreduce(np.ones(256, np.float32), op=hvd.Sum,
+                        name="grad/w")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+backend = state.backend
+s = dict(backend.stats)
+ctrl = state.runtime.controller
+assert ctrl.stats["pa_frames"] >= 1, ctrl.stats
+# Both layouts ran at some point during the search.
+assert s["hierarchical_allreduces"] > 0, s
+assert s["flat_allreduces"] > 0, s
+# The tuner's final decision reached the worker knobs.
+assert state.knobs.hierarchical_allreduce is not None
+print("FLIP OK", RANK, s, ctrl.stats["pa_frames"])
+"""
+    results = run_workers(body, nproc=2, timeout=240, extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "3",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "12",
+    })
+    assert_all_ok(results)
+
+
+def test_hierarchical_default_on_device_topology():
+    """When one process drives several chips, the eager allreduce must
+    default to the sharded all-local-chips layout without any knob
+    (VERDICT r2: default eager path idled 7/8 chips per host)."""
+    from multiproc import assert_all_ok, run_workers
+    body = """
+from horovod_tpu.common import basics
+state = basics._state()
+backend = state.backend
+assert len(backend.local_devices) == 2, backend.local_devices
+assert backend._hier_kind == "device", backend._hier_kind
+assert backend.hierarchical_active(), (
+    state.knobs.hierarchical_allreduce, backend._hier_kind)
+out = hvd.allreduce(np.arange(8.0, dtype=np.float32), op=hvd.Sum,
+                    name="t")
+np.testing.assert_allclose(np.asarray(out),
+                           2.0 * np.arange(8.0, dtype=np.float32))
+assert backend.stats["hierarchical_allreduces"] == 1, backend.stats
+print("DEVICE-DEFAULT OK", RANK)
+"""
+    results = run_workers(body, nproc=2, timeout=240, extra_env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    })
+    assert_all_ok(results)
