@@ -1,0 +1,139 @@
+"""Elastic TF2 ResNet-50 synthetic benchmark (a BASELINE config).
+
+TPU-native port of the reference example
+(reference: examples/elastic/tensorflow2/
+tensorflow2_synthetic_benchmark_elastic.py): DistributedGradientTape
+training wrapped in ``hvd.elastic.run`` with a committed
+TensorFlowKerasState, so workers can join/leave mid-run and training
+resumes from the last commit with the learning rate rescaled to the
+new world size.
+
+Run it statically:
+    horovodrun -np 2 -H localhost:2 python tensorflow2_resnet50_elastic.py
+or elastically:
+    horovodrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh \
+        python tensorflow2_resnet50_elastic.py
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+parser = argparse.ArgumentParser(
+    description="Elastic TF2 ResNet-50 synthetic benchmark",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--model", type=str, default="ResNet50",
+                    help="keras.applications model, or 'simple' for a "
+                         "tiny CNN (CI smoke)")
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--image-size", type=int, default=224)
+parser.add_argument("--fp16-allreduce", action="store_true")
+parser.add_argument("--num-warmup-batches", type=int, default=10)
+parser.add_argument("--num-batches-per-iter", type=int, default=10)
+parser.add_argument("--num-iters", type=int, default=10)
+parser.add_argument("--num-batches-per-commit", type=int, default=1)
+args = parser.parse_args()
+
+hvd.init()
+
+lr = 0.01
+
+
+def build_model():
+    if args.model == "simple":
+        return tf.keras.Sequential([
+            tf.keras.layers.Input((args.image_size, args.image_size, 3)),
+            tf.keras.layers.Conv2D(8, 3, activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(10),
+        ])
+    return getattr(tf.keras.applications, args.model)(
+        weights=None, input_shape=(args.image_size, args.image_size, 3),
+        classes=1000)
+
+
+model = build_model()
+opt = tf.optimizers.SGD(lr * hvd.size())
+num_classes = 10 if args.model == "simple" else 1000
+
+data = tf.random.uniform([args.batch_size, args.image_size,
+                          args.image_size, 3])
+target = tf.random.uniform([args.batch_size, 1], minval=0,
+                           maxval=num_classes, dtype=tf.int64)
+
+compression = (hvd.Compression.fp16 if args.fp16_allreduce
+               else hvd.Compression.none)
+
+
+@tf.function
+def train_one_batch():
+    with tf.GradientTape() as tape:
+        logits = model(data, training=True)
+        loss = tf.losses.sparse_categorical_crossentropy(
+            target, logits, from_logits=True)
+    tape = hvd.DistributedGradientTape(tape, compression=compression)
+    gradients = tape.gradient(loss, model.trainable_variables)
+    opt.apply_gradients(zip(gradients, model.trainable_variables))
+
+
+def benchmark_step(state):
+    train_one_batch()
+    if state is not None:
+        state.batch += 1
+        if state.batch == args.num_batches_per_commit:
+            state.batch = 0
+            state.commit()
+
+
+def log(s):
+    if hvd.rank() == 0:
+        print(s, flush=True)
+
+
+log(f"Model: {args.model}  batch {args.batch_size}  "
+    f"workers {hvd.size()}")
+
+# One batch before sync so weights exist to broadcast.
+train_one_batch()
+
+
+@hvd.elastic.run
+def run_benchmark(state):
+    if not state.warm:
+        log("Running warmup...")
+        timeit.timeit(lambda: benchmark_step(state),
+                      number=args.num_warmup_batches)
+        state.warm = True
+        state.commit()
+    if state.iter == 0:
+        log("Running benchmark...")
+    for x in range(state.iter, args.num_iters):
+        dt = timeit.timeit(lambda: benchmark_step(state),
+                           number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        log(f"Iter #{x}: {img_sec:.1f} img/sec per worker")
+        state.img_secs.append(img_sec)
+        state.iter = x
+        state.commit()
+
+
+def on_state_reset():
+    # World size changed: rescale the learning rate (reference
+    # example's on_state_reset).
+    opt.learning_rate.assign(lr * hvd.size())
+
+
+state = hvd.elastic.TensorFlowKerasState(
+    model, opt, img_secs=[], iter=0, batch=0, warm=False)
+state.register_reset_callbacks([on_state_reset])
+run_benchmark(state)
+
+if hvd.rank() == 0 and state.img_secs:
+    mean = np.mean(state.img_secs)
+    log(f"Total img/sec on {hvd.size()} workers: "
+        f"{mean * hvd.size():.1f} (per worker {mean:.1f})")

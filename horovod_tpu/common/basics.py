@@ -209,8 +209,9 @@ def init(comm=None, process_sets=None):
 
         if state.knobs.timeline:
             from .timeline import Timeline
-            state.timeline = Timeline(state.knobs.timeline,
-                                      rank=state.rank_info.rank)
+            state.timeline = Timeline(
+                state.knobs.timeline, rank=state.rank_info.rank,
+                mark_cycles=state.knobs.timeline_mark_cycles)
             state.runtime.timeline = state.timeline
 
         if process_sets:

@@ -658,6 +658,10 @@ class NetworkController(Controller):
                     initial_fusion_bytes=(
                         state.knobs.fusion_threshold_bytes),
                     initial_cycle_ms=state.knobs.cycle_time_ms,
+                    # Explicit env settings pin the categorical dims.
+                    fixed_hierarchical=state.knobs.hierarchical_allreduce,
+                    fixed_cache=(False if state.knobs.cache_capacity == 0
+                                 else None),
                     log_path=state.knobs.autotune_log)
                 state.parameter_manager = param_manager
             self.server = self._make_server(state, port, param_manager)
@@ -717,7 +721,6 @@ class NetworkController(Controller):
                             state.knobs.fusion_threshold_bytes),
                         elastic=state.knobs.elastic,
                         allow_ephemeral_fallback=allow_ephemeral,
-                        param_manager=param_manager,
                         cache_capacity=state.knobs.cache_capacity,
                         stall_warning_time_s=stall_warn,
                         stall_shutdown_time_s=(

@@ -56,12 +56,23 @@ class ParameterManager:
                  initial_cycle_ms: float = 1.0,
                  log_path: Optional[str] = None,
                  tune_categorical: bool = True,
+                 fixed_hierarchical: Optional[bool] = None,
+                 fixed_cache: Optional[bool] = None,
                  on_update: Optional[Callable] = None):
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._max_samples = bayes_opt_max_samples
         self._on_update = on_update
-        self._combos = _COMBOS if tune_categorical else (_COMBOS[0],)
+        # Explicitly-set knobs are held fixed during tuning (the
+        # reference likewise only tunes parameters the user left
+        # unset, parameter_manager.cc SetAutoTuning semantics).
+        combos = _COMBOS if tune_categorical else (_COMBOS[0],)
+        combos = tuple(
+            c for c in combos
+            if (fixed_hierarchical is None or c[0] == fixed_hierarchical)
+            and (fixed_cache is None or c[1] == fixed_cache))
+        self._combos = combos or ((bool(fixed_hierarchical),
+                                   fixed_cache is not False),)
         self._bo = {c: BayesianOptimization(bounds=[FUSION_MB_BOUNDS],
                                             gp_noise=gp_noise)
                     for c in self._combos}

@@ -71,11 +71,17 @@ class KerasEstimator(HorovodEstimator):
         if resume_state is not None:
             try:
                 ckpt = pickle.loads(resume_state)
-                model_bytes, opt_vars = ckpt["model"], ckpt["opt_vars"]
             except Exception:
                 # Legacy/model-only checkpoint: raw .keras archive
                 # bytes with no optimizer slots.
                 model_bytes, opt_vars = resume_state, None
+            else:
+                if not isinstance(ckpt, dict) or "model" not in ckpt:
+                    raise ValueError(
+                        f"corrupt checkpoint for run {run_id!r}: "
+                        f"unexpected payload {type(ckpt).__name__}")
+                model_bytes = ckpt["model"]
+                opt_vars = ckpt.get("opt_vars")
             start_epoch = checkpoint_epoch(store, run_id) + 1
         else:
             model_bytes = _model_to_bytes(self.getModel())

@@ -396,3 +396,119 @@ def test_stall_attribution_names_missing_ranks(native):
                    "HOROVOD_STALL_CHECK_TIME_SECONDS": "1"})
     assert_all_ok(results)
     assert any("REPORTED" in out for _, out in results)
+
+
+# ---------------------------------------------------------------------------
+# quorum-sensitive protocol tests at nproc=4 (VERDICT r2 weak #8: the
+# interesting cache races are invisible at nproc=2)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_steady_state_nproc4(native):
+    results = run_workers("""
+        from horovod_tpu.common import basics
+        ctrl = basics._state().runtime.controller
+        for step in range(20):
+            y = np.asarray(hvd.allreduce(
+                np.full((32,), 1.0, np.float32), op=hvd.Sum, name="t"))
+            np.testing.assert_allclose(y, 4.0)
+        s = ctrl.stats
+        assert s["ch_frames"] >= 15 and s["rq_frames"] <= 3, s
+        print("OK", s["ch_frames"])
+    """, nproc=4, extra_env={"HOROVOD_TPU_NATIVE": native})
+    assert_all_ok(results)
+
+
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_partial_hit_set_nproc4(native):
+    """Three ranks hit their cache (CH bits), one rank submits the full
+    request with a MATCHING signature (cold worker cache): the
+    coordinator must merge bit contributions with the full request into
+    one correct renegotiated round, then steady state resumes."""
+    results = run_workers("""
+        from horovod_tpu.common import basics
+        ctrl = basics._state().runtime.controller
+        for step in range(5):
+            y = np.asarray(hvd.allreduce(
+                np.full((16,), float(RANK), np.float32), op=hvd.Sum,
+                name="t"))
+            np.testing.assert_allclose(y, 6.0)
+        rs_before = ctrl.stats["rs_frames"]
+        if RANK == 3:
+            # Simulate a cold worker cache (the degraded state the
+            # protocol self-heals from: per-rank capacity
+            # misconfiguration, advisor r2 finding 3): drop the local
+            # entry so this rank sends a full request while the other
+            # three send bits.
+            ent = ctrl.cache._entries.get("t")
+            assert ent is not None
+            ctrl.cache.evict_bits([ent[0]])
+        y = np.asarray(hvd.allreduce(
+            np.full((16,), float(RANK), np.float32), op=hvd.Sum,
+            name="t"))
+        np.testing.assert_allclose(y, 6.0)
+        # The degraded round renegotiated (RS frame), not CB-only.
+        assert ctrl.stats["rs_frames"] >= rs_before + 1, ctrl.stats
+        # Steady state resumes: the re-broadcast re-seeded rank 3.
+        ch_before = ctrl.stats["ch_frames"]
+        for step in range(5):
+            y = np.asarray(hvd.allreduce(
+                np.full((16,), float(RANK), np.float32), op=hvd.Sum,
+                name="t"))
+            np.testing.assert_allclose(y, 6.0)
+        assert ctrl.stats["ch_frames"] >= ch_before + 4, ctrl.stats
+        print("OK")
+    """, nproc=4, extra_env={"HOROVOD_TPU_NATIVE": native})
+    assert_all_ok(results)
+
+
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_tombstone_churn_nproc4(native):
+    """Capacity 2 with 3 live tensors: every round evicts, so CH bits
+    keep racing EV frames across 4 ranks — stale bits must resolve
+    through tombstones (renegotiation), never kill the job."""
+    results = run_workers("""
+        from horovod_tpu.common import basics
+        ctrl = basics._state().runtime.controller
+        for step in range(25):
+            for j, name in enumerate(("a", "b", "c")):
+                y = np.asarray(hvd.allreduce(
+                    np.full((8,), float(j), np.float32), op=hvd.Sum,
+                    name=name))
+                np.testing.assert_allclose(y, 4.0 * j)
+        assert ctrl.stats["ev_frames"] > 0, ctrl.stats
+        print("OK", ctrl.stats["ev_frames"])
+    """, nproc=4, extra_env={"HOROVOD_TPU_NATIVE": native,
+                             "HOROVOD_CACHE_CAPACITY": "2"})
+    assert_all_ok(results)
+
+
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_group_demotion_nproc4(native):
+    """Group atomicity under a 4-rank quorum: one member's shape change
+    demotes the whole group on every rank in the same round."""
+    results = run_workers("""
+        from horovod_tpu.common import basics
+        ctrl = basics._state().runtime.controller
+        xs = [np.full((8,), float(i + 1), np.float32) for i in range(3)]
+        for rep in range(6):
+            ys = hvd.grouped_allreduce(xs, op=hvd.Sum, name="gg")
+            for i, y in enumerate(ys):
+                np.testing.assert_allclose(np.asarray(y),
+                                           4.0 * (i + 1))
+        ch_before = ctrl.stats["ch_frames"]
+        xs2 = [np.full((8,), 1.0, np.float32),
+               np.full((4,), 2.0, np.float32),
+               np.full((8,), 3.0, np.float32)]
+        ys = hvd.grouped_allreduce(xs2, op=hvd.Sum, name="gg")
+        np.testing.assert_allclose(np.asarray(ys[0]), 4.0)
+        np.testing.assert_allclose(np.asarray(ys[1]), 8.0)
+        np.testing.assert_allclose(np.asarray(ys[2]), 12.0)
+        assert ctrl.stats["ch_frames"] == ch_before, ctrl.stats
+        for rep in range(3):
+            ys = hvd.grouped_allreduce(xs2, op=hvd.Sum, name="gg")
+            np.testing.assert_allclose(np.asarray(ys[1]), 8.0)
+        assert ctrl.stats["ch_frames"] > ch_before, ctrl.stats
+        print("OK")
+    """, nproc=4, extra_env={"HOROVOD_TPU_NATIVE": native})
+    assert_all_ok(results)

@@ -80,3 +80,24 @@ def test_programmatic_run():
 
     results = run(fn, args=(100,), np=2, env=_worker_env())
     assert results == [100, 101]
+
+
+def test_elastic_tf2_resnet50_example_static(tmp_path):
+    """The elastic TF2 example (a BASELINE config) must run end-to-end
+    through the real launcher on 2 localhost workers (tiny model)."""
+    pytest.importorskip("tensorflow")
+    from horovod_tpu.runner.tpu_run import launch_static
+    script = os.path.join(REPO, "examples", "elastic", "tensorflow2",
+                          "tensorflow2_resnet50_elastic.py")
+    outdir = tmp_path / "logs"
+    codes = launch_static(
+        [sys.executable, script, "--model", "simple",
+         "--image-size", "32", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2"],
+        "localhost:2", 2, env=_worker_env(),
+        output_filename=str(outdir), verbose=1, start_timeout=300)
+    assert codes == {0: 0, 1: 0}
+    stdout = (outdir / "rank.0" / "stdout").read_text()
+    assert "img/sec per worker" in stdout
+    assert "Total img/sec on 2 workers" in stdout

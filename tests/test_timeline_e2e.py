@@ -1,0 +1,82 @@
+"""Timeline e2e: a real 2-process run with HOROVOD_TIMELINE must emit
+valid chrome-tracing JSON with negotiation + execution spans
+(reference: test/parallel/test_timeline.py — run a job under
+HOROVOD_TIMELINE and validate the JSON)."""
+
+import json
+import os
+
+from multiproc import assert_all_ok, run_workers
+
+
+def test_timeline_2proc_valid_chrome_json(tmp_path):
+    tl = tmp_path / "timeline.json"
+    body = """
+    for step in range(4):
+        y = np.asarray(hvd.allreduce(np.ones((16,), np.float32),
+                                     op=hvd.Sum, name="grad/w"))
+        np.testing.assert_allclose(y, 2.0)
+    g = np.asarray(hvd.allgather(np.ones((RANK + 1, 2), np.float32),
+                                 name="gather/x"))
+    assert g.shape == (3, 2)
+    hvd.shutdown()
+    print("OK")
+    """
+    results = run_workers(body, nproc=2, extra_env={
+        "HOROVOD_TIMELINE": str(tl),
+        "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+    })
+    assert_all_ok(results)
+    assert tl.exists(), "rank 0 must write the timeline file"
+
+    events = json.loads(tl.read_text())
+    assert isinstance(events, list) and events, "chrome-tracing array"
+    names = {e.get("name") for e in events}
+    # Negotiation spans for both op types.
+    assert "NEGOTIATE_ALLREDUCE" in names, sorted(names)
+    assert "NEGOTIATE_ALLGATHER" in names, sorted(names)
+    # Execution activity spans on the XLA data plane.
+    assert "XLA_ALLREDUCE" in names, sorted(names)
+    # Cycle markers were requested.
+    assert "CYCLE_START" in names, names
+    # Thread metadata maps tids to tensor names.
+    tensor_names = {e["args"]["name"] for e in events
+                    if e.get("ph") == "M"}
+    assert "grad/w" in tensor_names and "gather/x" in tensor_names
+    # Every tid's B/E events balance (spans closed).
+    depth = {}
+    for e in events:
+        if e.get("ph") == "B":
+            depth[e["tid"]] = depth.get(e["tid"], 0) + 1
+        elif e.get("ph") == "E":
+            depth[e["tid"]] = depth.get(e["tid"], 0) - 1
+            assert depth[e["tid"]] >= 0, "E without matching B"
+    assert all(v == 0 for v in depth.values()), depth
+    # Timestamps are monotone non-negative microseconds.
+    ts = [e["ts"] for e in events if "ts" in e]
+    assert all(t >= 0 for t in ts)
+
+
+def test_timeline_runtime_start_stop(tmp_path):
+    """hvd.start_timeline/stop_timeline mid-run (reference:
+    horovod_start_timeline, operations.cc:738-764)."""
+    tl = tmp_path / "rt_timeline.json"
+    body = f"""
+    hvd.allreduce(np.ones((4,), np.float32), op=hvd.Sum, name="pre")
+    if RANK == 0:
+        hvd.start_timeline({str(tl)!r})
+    hvd.allreduce(np.ones((4,), np.float32), op=hvd.Sum, name="mid")
+    if RANK == 0:
+        hvd.stop_timeline()
+    hvd.allreduce(np.ones((4,), np.float32), op=hvd.Sum, name="post")
+    hvd.shutdown()
+    print("OK")
+    """
+    results = run_workers(body, nproc=2)
+    assert_all_ok(results)
+    assert tl.exists()
+    events = json.loads(tl.read_text())
+    spans = {e.get("name") for e in events}
+    meta = {e["args"]["name"] for e in events if e.get("ph") == "M"}
+    assert "mid" in meta, (spans, meta)
+    assert "post" not in meta, "events after stop_timeline leaked"
