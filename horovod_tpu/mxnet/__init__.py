@@ -1,16 +1,20 @@
-"""MXNet binding placeholder.
+"""MXNet binding — FORMALLY DESCOPED (see docs/mxnet_descope.md).
 
 The reference ships an MXNet binding (reference: horovod/mxnet/ —
 DistributedOptimizer, gluon DistributedTrainer, broadcast_parameters).
-MXNet reached end-of-life upstream (attic'd by Apache in 2023) and is
-not installed in TPU images; this module keeps the import surface with
-an actionable error instead of silently missing.
+MXNet reached end-of-life upstream (attic'd by Apache in September
+2023), has no TPU path, and is not installable in TPU images, so this
+framework deliberately does not implement the binding; this module
+keeps the import surface with an actionable error instead of a silent
+gap.  Migration: gluon → horovod_tpu.keras, module API →
+horovod_tpu.torch (full rationale in docs/mxnet_descope.md).
 """
 
-_MSG = ("horovod_tpu.mxnet requires the 'mxnet' package, which is not "
-        "installed (MXNet is end-of-life upstream). Use the JAX "
-        "(horovod_tpu.jax), PyTorch (horovod_tpu.torch) or Keras "
-        "(horovod_tpu.keras) bindings instead.")
+_MSG = ("horovod_tpu.mxnet is formally descoped: MXNet is end-of-life "
+        "upstream (Apache attic, Sept 2023) and has no TPU path. Use "
+        "the JAX (horovod_tpu.jax), PyTorch (horovod_tpu.torch) or "
+        "Keras (horovod_tpu.keras) bindings instead; see "
+        "docs/mxnet_descope.md for the migration table.")
 
 try:
     import mxnet  # noqa: F401
