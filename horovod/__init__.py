@@ -1,0 +1,11 @@
+"""Drop-in compatibility alias: ``horovod.*`` -> ``horovod_tpu.*``.
+
+The BASELINE contract requires the reference's example scripts to run
+unmodified (``import horovod.torch as hvd`` etc.).  Each submodule of
+this package replaces itself in sys.modules with the corresponding
+horovod_tpu binding, so every name, submodule, and module identity is
+the real implementation — this package holds no logic of its own.
+Do not install next to upstream Horovod.
+"""
+
+from horovod_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,7 @@
+"""Alias to horovod_tpu.spark (see horovod/__init__.py)."""
+
+import sys
+
+import horovod_tpu.spark as _impl
+
+sys.modules[__name__] = _impl

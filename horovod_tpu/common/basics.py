@@ -88,6 +88,10 @@ class HorovodTpuState:
         self.host_messages = None    # elastic host-update queue
         self.is_homogeneous = True
         self.distributed_client_owned = False
+        # Monotonic init counter: collective by construction (every
+        # rank inits in lockstep), used to namespace per-incarnation
+        # rendezvous keys (ring backend) across elastic resets.
+        self.init_generation = 0
 
     def require_init(self):
         if not self.initialized:
@@ -218,6 +222,7 @@ def init(comm=None, process_sets=None):
             for ps in process_sets:
                 add_process_set(ps)
 
+        state.init_generation += 1
         state.initialized = True
         logger.debug("horovod_tpu initialized: rank=%d size=%d local=%d/%d",
                      state.rank_info.rank, state.rank_info.size,
@@ -235,6 +240,8 @@ def shutdown():
         if state.timeline is not None:
             state.timeline.close()
             state.timeline = None
+        if state.backend is not None and hasattr(state.backend, "close"):
+            state.backend.close()
         state.backend = None
         if state.distributed_client_owned:
             # Tear down the jax.distributed client so a later init()

@@ -105,11 +105,15 @@ def construct_response(name: str, msgs: List[Request], size: int,
         process_set_ranks=tuple(first.process_set_ranks),
     )
     if first.request_type == RequestType.ALLGATHER:
-        # Record each rank's first-dimension size in rank order; joined
+        # Record each participating rank's first-dimension size in
+        # GROUP order (process-set ranks when given, else world rank
+        # order) — consumers slice tensor_sizes in group_size strides
+        # (xla_ops/ring_ops allgather, fusion, split_response); joined
         # (departed) ranks contribute zero rows.
         by_rank = {m.request_rank: m for m in msgs}
+        ranks = list(first.process_set_ranks) or list(range(size))
         sizes = []
-        for r in range(size):
+        for r in ranks:
             if r in by_rank:
                 shape = by_rank[r].tensor_shape
                 sizes.append(shape[0] if shape else 1)

@@ -324,9 +324,18 @@ class CoordinatorServer:
                     continue
                 live, name, sig, sizes, gid = resolved
                 first_dim = None
-                if sig[7] == int(RequestType.ALLGATHER) and sizes and \
-                        0 <= rank < len(sizes):
-                    first_dim = sizes[rank]
+                if sig[7] == int(RequestType.ALLGATHER) and sizes:
+                    # tensor_sizes are in GROUP order: index by the
+                    # rank's position in the process set when one is
+                    # given; a rank outside the set gets NO override
+                    # (mirrors the native coordinator).
+                    psr = sig[8]
+                    if psr:
+                        idx = psr.index(rank) if rank in psr else -1
+                    else:
+                        idx = rank
+                    if 0 <= idx < len(sizes):
+                        first_dim = sizes[idx]
                 req = signature_to_request(sig, rank, name, first_dim)
                 req.group_id = gid
                 # A tombstoned bit still counts as a contribution, but
@@ -678,10 +687,17 @@ class NetworkController(Controller):
             self._addr = (host, int(port))
         self._sock = self._connect()
         self._recv_buf: "queue.Queue" = queue.Queue()
+        self._on_receive = None
         self._recv_thread = threading.Thread(
             target=self._recv_loop, name="hvd-ctrl-recv", daemon=True)
         self._recv_thread.start()
         self._send_lock = threading.Lock()
+
+    def set_receive_callback(self, fn):
+        """Called (from the recv thread) whenever a frame is queued —
+        the runtime wires its wake event here so response pickup is
+        event-driven instead of a poll."""
+        self._on_receive = fn
 
     def _make_server(self, state, port, param_manager):
         """Prefer the native C++ coordinator (horovod_tpu/native); fall
@@ -830,6 +846,8 @@ class NetworkController(Controller):
                 if responses is None:
                     return  # desync; _broken_err set
                 self._recv_buf.put(responses)
+                if self._on_receive is not None:
+                    self._on_receive()
                 continue
             if magic == _MAGIC_EVICT:
                 self.stats["ev_frames"] += 1
@@ -843,11 +861,15 @@ class NetworkController(Controller):
                 # (hierarchical on/off changes the compiled collective
                 # program — a half-flipped world would hang).
                 self._recv_buf.put(("PA", json.loads(payload.decode())))
+                if self._on_receive is not None:
+                    self._on_receive()
                 continue
             self.stats["rs_frames"] += 1
             responses, _ = unpack_response_list(payload)
             self._seed_cache(responses)
             self._recv_buf.put(responses)
+            if self._on_receive is not None:
+                self._on_receive()
 
     def _seed_cache(self, responses: List[Response]):
         """Store per-tensor slices of newly negotiated responses under
@@ -935,8 +957,10 @@ class NetworkController(Controller):
             self._pending_params = None
         responses: List[Response] = []
         try:
-            # Block briefly: either a batch arrives or the cycle ends.
-            item = self._recv_buf.get(timeout=0.005)
+            # Non-blocking drain: the recv thread wakes the runtime's
+            # cycle event on arrival (set_receive_callback), so there
+            # is no poll-interval latency floor here.
+            item = self._recv_buf.get_nowait()
             while True:
                 if isinstance(item, tuple) and item[0] == "PA":
                     if responses:

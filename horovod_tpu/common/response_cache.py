@@ -89,14 +89,15 @@ def split_response(resp: Response, world_size: int) -> List[Response]:
     """Slice a (possibly fused) Response into per-tensor responses.
 
     For fused allgathers the tensor_sizes list is the concatenation of
-    per-rank row counts per tensor (``world_size`` entries each, see
-    fusion.py) — slice accordingly.
+    per-GROUP-rank row counts per tensor (group = process-set ranks
+    when given, else the world; see fusion.py) — slice accordingly.
     """
     out = []
     per_sizes = 0
-    if resp.response_type == ResponseType.ALLGATHER and world_size > 0 \
-            and len(resp.tensor_sizes) == world_size * len(resp.tensor_names):
-        per_sizes = world_size
+    group = len(resp.process_set_ranks) or world_size
+    if resp.response_type == ResponseType.ALLGATHER and group > 0 \
+            and len(resp.tensor_sizes) == group * len(resp.tensor_names):
+        per_sizes = group
     for i, name in enumerate(resp.tensor_names):
         out.append(Response(
             response_type=resp.response_type,

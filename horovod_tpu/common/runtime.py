@@ -49,6 +49,12 @@ class BackgroundRuntime:
         self.controller = self._make_controller()
         self._shutdown = threading.Event()
         self._wake = threading.Event()
+        # Event-driven receive: the controller's recv thread wakes the
+        # cycle loop the moment a response frame lands, so response
+        # pickup never waits out a poll interval (the reference pays a
+        # fixed cycle sleep here, operations.cc:587).
+        if hasattr(self.controller, "set_receive_callback"):
+            self.controller.set_receive_callback(self._wake.set)
         self._thread: Optional[threading.Thread] = None
         self._cycle_time_s = state.knobs.cycle_time_ms / 1000.0
         self._entry_sizes: Dict[str, int] = {}

@@ -20,6 +20,7 @@ logger = logging.getLogger("horovod_tpu.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "coordinator.cc")
+_SRC_COLL = os.path.join(_DIR, "collectives.cc")
 _BUILD_DIR = os.path.join(_DIR, "build")
 _LIB = os.path.join(_BUILD_DIR, "libhvdtpu_coord.so")
 
@@ -37,12 +38,15 @@ def ensure_built() -> bool:
     """Compile the shared library if missing/stale; returns success."""
     if not os.path.exists(_SRC):
         return False
-    if os.path.exists(_LIB) and \
-            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+    srcs = [_SRC]
+    if os.path.exists(_SRC_COLL):
+        srcs.append(_SRC_COLL)
+    if os.path.exists(_LIB) and all(
+            os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs):
         return True
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _LIB + ".tmp"]
+           *srcs, "-o", _LIB + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(_LIB + ".tmp", _LIB)

@@ -356,9 +356,17 @@ Response construct_response(const std::string& name,
   r.shapes = {first.shape};
   r.psr = first.psr;
   if (first.type == REQ_ALLGATHER) {
+    // Per-rank first-dim sizes in GROUP order (process-set ranks when
+    // given, else world order) — consumers slice tensor_sizes in
+    // group_size strides (mirrors controller.py construct_response).
     std::map<int32_t, const Request*> by_rank;
     for (const auto& m : msgs) by_rank[m.rank] = &m;
-    for (int rk = 0; rk < size; ++rk) {
+    std::vector<int32_t> ranks;
+    if (!first.psr.empty())
+      ranks.assign(first.psr.begin(), first.psr.end());
+    else
+      for (int rk = 0; rk < size; ++rk) ranks.push_back(rk);
+    for (int rk : ranks) {
       auto it = by_rank.find(rk);
       if (it != by_rank.end()) {
         const auto& sh = it->second->shape;
@@ -965,9 +973,17 @@ class Coordinator {
         continue;
       }
       int64_t first_dim = -1;
-      if (sig.rtype == REQ_ALLGATHER && !sizes.empty() && rank >= 0 &&
-          rank < int(sizes.size()))
-        first_dim = sizes[rank];
+      if (sig.rtype == REQ_ALLGATHER && !sizes.empty()) {
+        // tensor_sizes are in GROUP order: index by the rank's
+        // position in the process set when one is given.
+        int idx = rank;
+        if (!sig.psr.empty()) {
+          idx = -1;
+          for (size_t gi = 0; gi < sig.psr.size(); ++gi)
+            if (sig.psr[gi] == rank) { idx = int(gi); break; }
+        }
+        if (idx >= 0 && idx < int(sizes.size())) first_dim = sizes[idx];
+      }
       Request req = sig_to_request(sig, rank, name, first_dim);
       req.group_id = gid;
       // A tombstoned bit still counts, but forces the full path.
@@ -1166,10 +1182,11 @@ class Coordinator {
     for (const auto& kv : table_) pending.insert(kv.first);
     for (auto& resp : *fused) {
       if (!kCacheable.count(resp.type) || !resp.error.empty()) continue;
+      size_t group = resp.psr.empty() ? size_t(size_) : resp.psr.size();
       size_t per_sizes = 0;
-      if (resp.type == RESP_ALLGATHER && size_ > 0 &&
-          resp.sizes.size() == size_t(size_) * resp.names.size())
-        per_sizes = size_t(size_);
+      if (resp.type == RESP_ALLGATHER && group > 0 &&
+          resp.sizes.size() == group * resp.names.size())
+        per_sizes = group;
       resp.cache_bits.clear();
       for (size_t i = 0; i < resp.names.size(); ++i) {
         auto sit = sig_by_name.find(resp.names[i]);

@@ -16,6 +16,8 @@ Backend selection mirrors HOROVOD_CPU_OPERATIONS / HOROVOD_CONTROLLER
 (reference: utils/env_parser.cc) via HOROVOD_TPU_OPERATIONS.
 """
 
+import logging
+import os
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -123,4 +125,20 @@ def create_backend(state) -> Backend:
     if state.rank_info.size == 1:
         return SingleProcessBackend()
     from .xla_ops import XlaMeshBackend
-    return XlaMeshBackend(state)
+    xla = XlaMeshBackend(state)
+    # On CPU the native TCP ring beats per-call dispatch of a
+    # multi-controller XLA program by ~10x on the eager hot path; on
+    # TPU the compiled ICI collectives own the data plane. Knob:
+    # HOROVOD_CPU_OPERATIONS=RING|XLA (reference: HOROVOD_CPU_OPERATIONS
+    # selecting gloo vs mpi CPU ops, common.h:84-89).
+    import jax
+    choice = os.environ.get("HOROVOD_CPU_OPERATIONS", "RING").upper()
+    if jax.devices()[0].platform == "cpu" and choice == "RING":
+        try:
+            from .ring_ops import RingBackend
+            return RingBackend(state, xla)
+        except Exception:
+            logging.getLogger("horovod_tpu.ring").warning(
+                "ring backend unavailable; using XLA CPU collectives",
+                exc_info=True)
+    return xla

@@ -1,0 +1,324 @@
+"""CPU eager data plane over the native TCP ring collectives.
+
+The analog of the reference's Gloo CPU backend (reference:
+ops/gloo_operations.{h,cc} ring algorithms over the full-mesh TCP
+contexts of gloo/gloo_context.cc).  On TPU the data plane is compiled
+XLA collectives over ICI (:mod:`.xla_ops`); on CPU rigs, dispatching a
+multi-controller XLA program costs milliseconds per call, while the
+native ring over persistent sockets costs microseconds — so this
+backend owns the host-tensor hot path (allreduce/allgather/broadcast/
+barrier) and delegates everything else (alltoall, reducescatter,
+Adasum, exotic dtypes) to the XLA backend.
+
+Selection (reference knob HOROVOD_CPU_OPERATIONS, common.h:84-89):
+``HOROVOD_CPU_OPERATIONS=RING`` (default on CPU) or ``XLA``.
+"""
+
+import ctypes
+import logging
+import os
+from typing import List
+
+import numpy as np
+
+from .backend import Backend
+
+logger = logging.getLogger("horovod_tpu.ring")
+
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+# Upcast table for dtypes the C kernels don't reduce natively.
+_UPCAST = {
+    np.dtype(np.float16): np.float32,
+    np.dtype(np.int8): np.int32,
+    np.dtype(np.uint8): np.int32,
+    np.dtype(np.int16): np.int32,
+    np.dtype(np.uint16): np.int32,
+    np.dtype(np.uint32): np.int64,
+    # bool reduces as int32; astype(bool) on the way out restores
+    # logical semantics (Min=AND, Max=OR, Sum=count-nonzero-saturated).
+    np.dtype(np.bool_): np.int32,
+}
+try:
+    import ml_dtypes
+    _UPCAST[np.dtype(ml_dtypes.bfloat16)] = np.float32
+except ImportError:
+    pass
+
+_OPS = {"Sum": 0, "Average": 0, "Product": 1, "Min": 2, "Max": 3}
+
+
+def _bind(lib):
+    lib.hvd_ring_create.restype = ctypes.c_void_p
+    lib.hvd_ring_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hvd_ring_listen.restype = ctypes.c_int
+    lib.hvd_ring_listen.argtypes = [ctypes.c_void_p]
+    lib.hvd_ring_connect.restype = ctypes.c_int
+    lib.hvd_ring_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvd_ring_allreduce.restype = ctypes.c_int
+    lib.hvd_ring_allreduce.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int]
+    lib.hvd_ring_allgather.restype = ctypes.c_int
+    lib.hvd_ring_allgather.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_ring_broadcast.restype = ctypes.c_int
+    lib.hvd_ring_broadcast.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_ring_barrier.restype = ctypes.c_int
+    lib.hvd_ring_barrier.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_ring_destroy.argtypes = [ctypes.c_void_p]
+
+
+def _kv_client():
+    from jax._src import distributed as _dist
+    client = _dist.global_state.client
+    if client is None:
+        raise RuntimeError("jax.distributed is not initialized")
+    return client
+
+
+class RingBackend(Backend):
+    name = "ring"
+
+    def __init__(self, state, fallback: Backend):
+        from ..native import load
+
+        self.state = state
+        self.fallback = fallback
+        self.size = state.rank_info.size
+        self.rank = state.rank_info.rank
+        # Shared stats dict: ring counters live next to the fallback's
+        # (hierarchical/flat) counters so observers see one view.
+        self.stats = getattr(fallback, "stats", {})
+        self.stats.setdefault("ring_allreduces", 0)
+        self._lib = None
+        self._comm = None
+        lib = load()
+        # The backend choice must be COLLECTIVE: one rank on the ring
+        # while another silently falls back to XLA would hang at the
+        # first op. Every rank therefore publishes its address OR an
+        # explicit failure marker, and anyone seeing a marker aborts
+        # to the fallback everywhere. Keys are namespaced by the init
+        # generation so repeated init() against a persistent
+        # jax.distributed client never reads a previous incarnation's
+        # (dead) addresses.
+        gen = getattr(state, "init_generation", 0)
+        key = f"hvd_ring/{gen}/{{}}"
+        client = _kv_client()
+        try:
+            if lib is None:
+                raise RuntimeError("native library unavailable")
+            _bind(lib)
+            self._lib = lib
+            self._comm = lib.hvd_ring_create(self.rank, self.size)
+            port = lib.hvd_ring_listen(self._comm)
+            if port <= 0:
+                raise RuntimeError("ring listen failed")
+            my_addr = f"{self._my_ip()}:{port}"
+        except Exception:
+            try:
+                client.key_value_set(key.format(self.rank), "FAIL")
+            except Exception:
+                pass
+            self.close()
+            raise
+        try:
+            # Address exchange over the jax coordination-service KV
+            # store (the same service jax.distributed.initialize stood
+            # up — the analog of the reference's rendezvous KV,
+            # gloo/gloo_context.cc:63-84).
+            client.key_value_set(key.format(self.rank), my_addr)
+            addrs = [
+                client.blocking_key_value_get(key.format(r), 60_000)
+                for r in range(self.size)
+            ]
+            if any(a == "FAIL" for a in addrs):
+                raise RuntimeError(
+                    f"ring setup failed on rank(s) "
+                    f"{[r for r, a in enumerate(addrs) if a == 'FAIL']}"
+                    "; all ranks use the XLA fallback")
+            rc = lib.hvd_ring_connect(self._comm,
+                                      ",".join(addrs).encode())
+            if rc != 0:
+                raise RuntimeError(f"ring mesh connect failed (rc={rc})")
+        except Exception:
+            self.close()
+            raise
+        logger.debug("ring backend up: rank %d/%d via %s", self.rank,
+                     self.size, my_addr)
+
+    @staticmethod
+    def _my_ip() -> str:
+        import socket
+        ctrl = os.environ.get("HOROVOD_CONTROLLER_ADDR") or \
+            os.environ.get("HOROVOD_TPU_COORDINATOR")
+        if ctrl and ":" in ctrl:
+            host, _, port = ctrl.rpartition(":")
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s.connect((host, int(port)))
+                ip = s.getsockname()[0]
+                s.close()
+                return ip
+            except OSError:
+                pass
+        return "127.0.0.1"
+
+    def close(self):
+        if self._comm is not None:
+            self._lib.hvd_ring_destroy(self._comm)
+            self._comm = None
+
+    # -- helpers ---------------------------------------------------------
+    def _group_args(self, ps_ranks):
+        if not ps_ranks:
+            return None, 0, self.size
+        arr = (ctypes.c_int * len(ps_ranks))(*ps_ranks)
+        return arr, len(ps_ranks), len(ps_ranks)
+
+    def world_size(self, ps_ranks=()) -> int:
+        return len(ps_ranks) if ps_ranks else self.size
+
+    @staticmethod
+    def _scale(x: np.ndarray, factor: float) -> np.ndarray:
+        if factor == 1.0:
+            return x
+        if np.issubdtype(x.dtype, np.inexact):
+            return x * x.dtype.type(factor)
+        return (x * factor).astype(x.dtype)
+
+    # -- allreduce -------------------------------------------------------
+    def allreduce(self, arrays, reduce_op, prescale, postscale,
+                  ps_ranks=()):
+        dt = np.result_type(*(np.asarray(a).dtype for a in arrays)) \
+            if arrays else np.float32
+        if reduce_op not in _OPS or \
+                np.iscomplexobj(np.asarray(arrays[0])):
+            return self.fallback.allreduce(arrays, reduce_op, prescale,
+                                           postscale, ps_ranks)
+        ranks_arr, nranks, gsize = self._group_args(tuple(ps_ranks))
+
+        self.stats["ring_allreduces"] += 1
+        was_jax = [self._is_jax(a) for a in arrays]
+        nps = [np.asarray(a) for a in arrays]
+        orig_dtypes = [a.dtype for a in nps]
+        work_dt = np.dtype(dt)
+        if work_dt in _UPCAST:
+            work_dt = np.dtype(_UPCAST[work_dt])
+        if work_dt not in _DTYPES:
+            return self.fallback.allreduce(arrays, reduce_op, prescale,
+                                           postscale, ps_ranks)
+        flat = [self._scale(a, prescale).astype(work_dt).ravel()
+                for a in nps]
+        # One contiguous fused buffer per call: the in-place ring runs
+        # once over the whole batch (the reference's fusion-buffer
+        # memcpy in/out, collective_operations.h:96-125).
+        buf = np.ascontiguousarray(np.concatenate(flat)) if flat else \
+            np.zeros(0, work_dt)
+        if buf.size:
+            rc = self._lib.hvd_ring_allreduce(
+                self._comm, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.size, _DTYPES[work_dt], _OPS[reduce_op],
+                ranks_arr, nranks)
+            if rc != 0:
+                raise RuntimeError(f"ring allreduce failed (rc={rc})")
+        post = postscale
+        if reduce_op == "Average":
+            post = postscale / gsize
+        out, off = [], 0
+        for a, odt, wj in zip(nps, orig_dtypes, was_jax):
+            piece = buf[off:off + a.size].reshape(a.shape)
+            off += a.size
+            piece = self._scale(piece, post)
+            if piece.dtype != odt:
+                piece = piece.astype(odt)
+            elif piece.base is not None:
+                # Own the memory: a view into the fused buffer would
+                # pin the whole batch for as long as any output lives.
+                piece = piece.copy()
+            out.append(self._rewrap(piece, wj))
+        return out
+
+    @staticmethod
+    def _is_jax(x) -> bool:
+        import jax
+        return isinstance(x, jax.Array)
+
+    @staticmethod
+    def _rewrap(x: np.ndarray, was_jax: bool):
+        if not was_jax:
+            return x
+        import jax.numpy as jnp
+        return jnp.asarray(x)
+
+    def adasum_allreduce(self, arrays, prescale, postscale, ps_ranks=()):
+        return self.fallback.adasum_allreduce(arrays, prescale,
+                                              postscale, ps_ranks)
+
+    # -- allgather -------------------------------------------------------
+    def allgather(self, arrays, sizes, ps_ranks=()):
+        ranks_arr, nranks, gsize = self._group_args(tuple(ps_ranks))
+        per_tensor_sizes = [sizes[i * gsize:(i + 1) * gsize]
+                            for i in range(len(arrays))]
+        out = []
+        for x, tsizes in zip(arrays, per_tensor_sizes):
+            wj = self._is_jax(x)
+            a = np.ascontiguousarray(np.asarray(x))
+            if a.ndim == 0:
+                a = a[None]
+            row_bytes = a[0:1].nbytes if a.shape[0] else \
+                a.dtype.itemsize * int(np.prod(a.shape[1:], initial=1))
+            counts = (ctypes.c_longlong * gsize)(
+                *[int(t) * row_bytes for t in tsizes])
+            total_rows = int(sum(tsizes))
+            res = np.empty((total_rows,) + a.shape[1:], a.dtype)
+            rc = self._lib.hvd_ring_allgather(
+                self._comm, a.ctypes.data_as(ctypes.c_void_p),
+                a.nbytes, res.ctypes.data_as(ctypes.c_void_p),
+                counts, ranks_arr, nranks)
+            if rc != 0:
+                raise RuntimeError(f"ring allgather failed (rc={rc})")
+            out.append(self._rewrap(res, wj))
+        return out
+
+    # -- broadcast -------------------------------------------------------
+    def broadcast(self, arrays, root_rank, ps_ranks=()):
+        ranks_arr, nranks, _ = self._group_args(tuple(ps_ranks))
+        root = list(ps_ranks).index(root_rank) if ps_ranks else root_rank
+        out = []
+        for x in arrays:
+            wj = self._is_jax(x)
+            # np.array (not ascontiguousarray, which promotes 0-d
+            # arrays to 1-d) so scalars keep their shape.
+            a = np.array(x, copy=True, order="C")
+            rc = self._lib.hvd_ring_broadcast(
+                self._comm, a.ctypes.data_as(ctypes.c_void_p),
+                a.nbytes, int(root), ranks_arr, nranks)
+            if rc != 0:
+                raise RuntimeError(f"ring broadcast failed (rc={rc})")
+            out.append(self._rewrap(a, wj))
+        return out
+
+    # -- delegated ops ---------------------------------------------------
+    def alltoall(self, array, splits, ps_ranks=()):
+        return self.fallback.alltoall(array, splits, ps_ranks)
+
+    def reducescatter(self, arrays, reduce_op, ps_ranks=()):
+        return self.fallback.reducescatter(arrays, reduce_op, ps_ranks)
+
+    def barrier(self, ps_ranks=()):
+        ranks_arr, nranks, _ = self._group_args(tuple(ps_ranks))
+        rc = self._lib.hvd_ring_barrier(self._comm, ranks_arr, nranks)
+        if rc != 0:
+            raise RuntimeError(f"ring barrier failed (rc={rc})")
+        return None

@@ -268,13 +268,28 @@ def process_set_included_op(process_set=global_process_set, name=None):
 def broadcast_variables(variables, root_rank: int,
                         process_set=global_process_set):
     """Assign every variable its root_rank value (reference:
-    tensorflow/__init__.py:263-330 broadcast_global_variables)."""
+    tensorflow/__init__.py:263-330 broadcast_global_variables).
+
+    Works both eagerly and inside a traced ``tf.function`` (the
+    reference's TF2 examples call it from the first traced train step):
+    traced calls lower through the graph broadcast path (in-graph
+    collectives or the py_function fallback)."""
+    variables = list(variables)
+    if tf.executing_eagerly():
+        for i, var in enumerate(variables):
+            name = getattr(var, "name", None) or f"bcast_var.{i}"
+            value = _ops.broadcast(_to_numpy(var), root_rank,
+                                   name=f"bcast/{name}",
+                                   process_set=process_set)
+            var.assign(np.asarray(value))
+        return None
+    assigns = []
     for i, var in enumerate(variables):
         name = getattr(var, "name", None) or f"bcast_var.{i}"
-        value = _ops.broadcast(_to_numpy(var), root_rank,
-                               name=f"bcast/{name}",
-                               process_set=process_set)
-        var.assign(np.asarray(value))
+        value = broadcast(tf.convert_to_tensor(var), root_rank,
+                          name=f"bcast/{name}", process_set=process_set)
+        assigns.append(var.assign(value))
+    return tf.group(*assigns) if assigns else None
 
 
 def broadcast_global_variables(root_rank: int):

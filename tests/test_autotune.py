@@ -117,6 +117,8 @@ assert state.knobs.hierarchical_allreduce is not None
 print("FLIP OK", RANK, s, ctrl.stats["pa_frames"])
 """
     results = run_workers(body, nproc=2, timeout=240, extra_env={
+        "HOROVOD_CPU_OPERATIONS": "XLA",   # the knob under test lives
+                                           # in the XLA data plane
         "HOROVOD_AUTOTUNE": "1",
         "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
         "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "3",
@@ -147,5 +149,6 @@ print("DEVICE-DEFAULT OK", RANK)
 """
     results = run_workers(body, nproc=2, timeout=240, extra_env={
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "HOROVOD_CPU_OPERATIONS": "XLA",
     })
     assert_all_ok(results)

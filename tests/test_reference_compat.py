@@ -1,0 +1,107 @@
+"""Drop-in compatibility: the REFERENCE repository's own example
+scripts run unmodified against the ``horovod`` alias package (BASELINE:
+'reference scripts that must run unmodified').  The scripts are
+executed directly from /root/reference — nothing is copied."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+
+TF2_BENCH = os.path.join(REFERENCE, "examples", "tensorflow2",
+                         "tensorflow2_synthetic_benchmark.py")
+PT_BENCH = os.path.join(REFERENCE, "examples", "pytorch",
+                        "pytorch_synthetic_benchmark.py")
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HOROVOD_TPU_FORCE_CPU"] = "1"
+    env.pop("XLA_FLAGS", None)
+    env.pop("HOROVOD_RANK", None)
+    return env
+
+
+@pytest.mark.skipif(not os.path.exists(TF2_BENCH),
+                    reason="reference checkout unavailable")
+def test_reference_tf2_synthetic_benchmark_unmodified(tmp_path):
+    """The script exercises init, rank/size/local_rank, Compression,
+    DistributedGradientTape (traced), and broadcast_variables of the
+    model — all of which must work through the alias.  The script's
+    LAST hvd-adjacent line, ``hvd.broadcast_variables(opt.variables(),
+    ...)``, calls ``opt.variables`` as a METHOD, which modern Keras
+    made a property — an upstream script-vs-TF incompatibility
+    (TypeError: 'list' object is not callable) independent of this
+    framework, tolerated below; any other failure mode fails the
+    test."""
+    from horovod_tpu.runner.tpu_run import launch_static
+    outdir = tmp_path / "logs"
+    try:
+        codes = launch_static(
+            [sys.executable, TF2_BENCH, "--model", "MobileNetV3Small",
+             "--batch-size", "1", "--num-warmup-batches", "1",
+             "--num-batches-per-iter", "1", "--num-iters", "2"],
+            "localhost:2", 2, env=_worker_env(),
+            output_filename=str(outdir), verbose=1, start_timeout=600)
+    except RuntimeError:
+        codes = None
+    stdout = (outdir / "rank.0" / "stdout").read_text()
+    stderr = (outdir / "rank.0" / "stderr").read_text()
+    if codes == {0: 0, 1: 0}:
+        assert "Total img/sec on 2 CPU(s)" in stdout, stdout[-2000:]
+        return
+    # Known upstream break only — and the run must have gotten THROUGH
+    # the traced first step (graph build + model-variable broadcast).
+    assert "'list' object is not callable" in stderr, stderr[-3000:]
+    assert "opt.variables()" in stderr, stderr[-3000:]
+
+
+@pytest.mark.skipif(not os.path.exists(PT_BENCH),
+                    reason="reference checkout unavailable")
+def test_reference_pytorch_synthetic_benchmark_unmodified(tmp_path):
+    pytest.importorskip(
+        "torchvision",
+        reason="reference script imports torchvision (not installed)")
+    from horovod_tpu.runner.tpu_run import launch_static
+    outdir = tmp_path / "logs"
+    codes = launch_static(
+        [sys.executable, PT_BENCH, "--model", "squeezenet1_0",
+         "--batch-size", "1", "--num-warmup-batches", "1",
+         "--num-batches-per-iter", "1", "--num-iters", "2", "--no-cuda"],
+        "localhost:2", 2, env=_worker_env(),
+        output_filename=str(outdir), verbose=1, start_timeout=600)
+    assert codes == {0: 0, 1: 0}
+    stdout = (outdir / "rank.0" / "stdout").read_text()
+    assert "Total img/sec on 2 CPU(s)" in stdout, stdout[-2000:]
+
+
+def test_alias_package_surface():
+    """Every horovod.* alias resolves to the horovod_tpu implementation
+    with the expected API surface."""
+    import horovod
+    import horovod.torch as ht
+    import horovod.tensorflow as htf
+    import horovod.tensorflow.keras as htk
+    import horovod.keras as hk
+    import horovod.spark as hs
+    import horovod.ray as hr
+    import horovod.elastic as he
+
+    assert horovod.__version__
+    for mod, names in [
+            (ht, ["DistributedOptimizer", "broadcast_parameters",
+                  "broadcast_optimizer_state", "allreduce_async"]),
+            (htf, ["DistributedGradientTape", "DistributedOptimizer",
+                   "broadcast_variables", "elastic"]),
+            (htk, ["DistributedOptimizer", "callbacks"]),
+            (hk, ["DistributedOptimizer", "callbacks"]),
+            (hs, ["run", "Store", "FilesystemStore"]),
+            (hr, ["RayExecutor"]),
+            (he, ["State", "run_fn"]),
+    ]:
+        for n in names:
+            assert hasattr(mod, n), (mod.__name__, n)

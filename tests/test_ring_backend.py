@@ -1,0 +1,126 @@
+"""Native TCP ring collectives backend (reference parity:
+ops/gloo_operations.{h,cc} — the CPU data plane).  Correctness across
+op types, dtypes, process-set subgroups, ragged allgather, and
+payloads large enough to cross the duplex-threading threshold."""
+
+import pytest
+
+from multiproc import assert_all_ok, run_workers
+
+_RING_CHECK = """
+from horovod_tpu.common import basics
+state = basics._state()
+assert type(state.backend).__name__ == "RingBackend", type(state.backend)
+"""
+
+
+def test_ring_is_default_cpu_backend():
+    results = run_workers(_RING_CHECK + """
+print("OK")
+""", nproc=2)
+    assert_all_ok(results)
+
+
+def test_ring_ops_correctness_nproc3():
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+
+# allreduce across ops and dtypes (f32/f64/i32/i64 native; f16/bf16
+# upcast; bool falls back to the XLA path)
+for dt in (np.float32, np.float64, np.int32, np.int64, np.float16):
+    x = (np.arange(5) + RANK + 1).astype(dt)
+    y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=f"s.{dt.__name__}"))
+    exp = (np.arange(5)[None, :] + np.arange(1, SIZE + 1)[:, None]).sum(0)
+    np.testing.assert_allclose(y.astype(np.float64), exp, rtol=1e-3)
+
+y = np.asarray(hvd.allreduce(np.full(4, float(RANK + 1), np.float32),
+                             op=hvd.Max, name="mx"))
+np.testing.assert_allclose(y, SIZE)
+y = np.asarray(hvd.allreduce(np.full(4, 2.0, np.float32),
+                             op=hvd.Product, name="pr"))
+np.testing.assert_allclose(y, 2.0 ** SIZE)
+y = np.asarray(hvd.allreduce(np.full(4, float(RANK), np.float32),
+                             op=hvd.Average, name="av"))
+np.testing.assert_allclose(y, (SIZE - 1) / 2.0)
+y = np.asarray(hvd.allreduce(np.array([RANK % 2 == 0, True]),
+                             op=hvd.Min, name="bool"))
+np.testing.assert_array_equal(y, [False, True])
+
+# ragged allgather: rank r contributes r+1 rows
+g = np.asarray(hvd.allgather(
+    np.full((RANK + 1, 3), float(RANK), np.float32), name="ag"))
+assert g.shape == (SIZE * (SIZE + 1) // 2, 3), g.shape
+off = 0
+for r in range(SIZE):
+    np.testing.assert_allclose(g[off:off + r + 1], float(r))
+    off += r + 1
+
+# broadcast from a non-zero root
+b = np.asarray(hvd.broadcast(
+    np.full(6, float(RANK * 10), np.float32), root_rank=2, name="bc"))
+np.testing.assert_allclose(b, 20.0)
+
+# large payload (crosses the 4MB duplex-thread threshold)
+big = np.full(3 * 1024 * 1024, float(RANK + 1), np.float32)  # 12 MB
+y = np.asarray(hvd.allreduce(big, op=hvd.Sum, name="big"))
+np.testing.assert_allclose(y[:4], sum(range(1, SIZE + 1)))
+np.testing.assert_allclose(y[-4:], sum(range(1, SIZE + 1)))
+
+# scalar broadcast keeps its 0-d shape (regression: ascontiguousarray
+# promoted 0-d to 1-d, breaking keras iteration-counter broadcast)
+sc = np.asarray(hvd.broadcast(np.int64(5 if RANK == 0 else 0),
+                              root_rank=0, name="scalar"))
+assert sc.shape == () and int(sc) == 5, (sc.shape, sc)
+
+# barrier completes
+hvd.barrier()
+assert state.backend.stats["ring_allreduces"] > 0
+print("OK")
+""", nproc=3, timeout=240)
+    assert_all_ok(results)
+
+
+def test_ring_process_set_subgroup():
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+ps = hvd.add_process_set([0, 2])
+if RANK in (0, 2):
+    y = np.asarray(hvd.allreduce(np.full(4, float(RANK + 1), np.float32),
+                                 op=hvd.Sum, name="sub",
+                                 process_set=ps))
+    np.testing.assert_allclose(y, 4.0)   # ranks 0 and 2: 1 + 3
+    g = np.asarray(hvd.allgather(np.full((1, 2), float(RANK), np.float32),
+                                 name="subg", process_set=ps))
+    assert g.shape == (2, 2), g.shape
+# world op afterwards still works
+y = np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                             name="world"))
+np.testing.assert_allclose(y, SIZE)
+print("OK")
+""", nproc=3, timeout=240)
+    assert_all_ok(results)
+
+
+def test_cpu_operations_knob_forces_xla():
+    results = run_workers("""
+from horovod_tpu.common import basics
+assert type(basics._state().backend).__name__ == "XlaMeshBackend"
+y = np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                             name="t"))
+np.testing.assert_allclose(y, SIZE)
+print("OK")
+""", nproc=2, extra_env={"HOROVOD_CPU_OPERATIONS": "XLA"})
+    assert_all_ok(results)
+
+
+def test_jax_array_roundtrip_stays_jax():
+    results = run_workers(_RING_CHECK + """
+import jax.numpy as jnp
+import jax
+x = jnp.ones(8, jnp.float32) * (RANK + 1)
+y = hvd.allreduce(x, op=hvd.Sum, name="jx")
+assert isinstance(y, jax.Array), type(y)
+np.testing.assert_allclose(np.asarray(y), 3.0)
+print("OK")
+""", nproc=2)
+    assert_all_ok(results)
