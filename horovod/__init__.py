@@ -1,11 +1,54 @@
 """Drop-in compatibility alias: ``horovod.*`` -> ``horovod_tpu.*``.
 
 The BASELINE contract requires the reference's example scripts to run
-unmodified (``import horovod.torch as hvd`` etc.).  Each submodule of
-this package replaces itself in sys.modules with the corresponding
-horovod_tpu binding, so every name, submodule, and module identity is
-the real implementation — this package holds no logic of its own.
+unmodified (``import horovod.torch as hvd``,
+``import horovod.tensorflow.keras as hvd``, ...).  A meta-path finder
+redirects every ``horovod.X...`` import to the already-imported
+``horovod_tpu.X...`` module object itself, so names, submodules, AND
+module identity are the real implementation at any depth — no
+duplicate module objects (an aliased ElasticSampler is the
+horovod_tpu ElasticSampler).  This package holds no logic of its own.
 Do not install next to upstream Horovod.
 """
 
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
 from horovod_tpu.version import __version__  # noqa: F401
+
+# Aliases whose implementation path is not a literal horovod_tpu.<X>.
+_SPECIAL = {
+    "horovod.elastic": "horovod_tpu.common.elastic",
+}
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, target: str):
+        self._target = target
+
+    def create_module(self, spec):
+        # Returning the impl module makes the import system register
+        # IT under the alias name — identical object, no re-execution.
+        return importlib.import_module(self._target)
+
+    def exec_module(self, module):
+        pass
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("horovod."):
+            return None
+        impl = _SPECIAL.get(fullname) or \
+            "horovod_tpu." + fullname[len("horovod."):]
+        try:
+            importlib.import_module(impl)
+        except ImportError:
+            return None
+        return importlib.util.spec_from_loader(fullname,
+                                               _AliasLoader(impl))
+
+
+sys.meta_path.insert(0, _AliasFinder())

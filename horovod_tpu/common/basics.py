@@ -88,9 +88,9 @@ class HorovodTpuState:
         self.host_messages = None    # elastic host-update queue
         self.is_homogeneous = True
         self.distributed_client_owned = False
-        # Monotonic init counter: collective by construction (every
-        # rank inits in lockstep), used to namespace per-incarnation
-        # rendezvous keys (ring backend) across elastic resets.
+        # Monotonic per-process init counter (observability; NOT safe
+        # as a cross-rank namespace — freshly spawned elastic workers
+        # start at 0 while survivors are at N).
         self.init_generation = 0
 
     def require_init(self):

@@ -103,18 +103,27 @@ class RingBackend(Backend):
         self.stats.setdefault("ring_allreduces", 0)
         self._lib = None
         self._comm = None
+        self._keys = []
         lib = load()
         # The backend choice must be COLLECTIVE: one rank on the ring
         # while another silently falls back to XLA would hang at the
-        # first op. Every rank therefore publishes its address OR an
-        # explicit failure marker, and anyone seeing a marker aborts
-        # to the fallback everywhere. Keys are namespaced by the init
-        # generation so repeated init() against a persistent
-        # jax.distributed client never reads a previous incarnation's
-        # (dead) addresses.
-        gen = getattr(state, "init_generation", 0)
-        key = f"hvd_ring/{gen}/{{}}"
-        client = _kv_client()
+        # first op. Protocol: (1) every rank publishes its ring address
+        # OR an explicit FAIL marker and anyone seeing a marker aborts
+        # everywhere; (2) after the mesh connect, a second unanimous
+        # OK round catches per-rank connect failures (timeouts), again
+        # demoting everyone together. Keys are namespaced by the
+        # launcher endpoints (shared per incarnation by ALL workers,
+        # including freshly spawned elastic joiners that have no local
+        # init history) and deleted on close so a later re-init against
+        # a persistent jax.distributed client starts clean.
+        import hashlib
+        ns = hashlib.sha1(
+            (os.environ.get("HOROVOD_TPU_COORDINATOR", "") + "|" +
+             os.environ.get("HOROVOD_CONTROLLER_ADDR", "")).encode()
+        ).hexdigest()[:12]
+        addr_key = f"hvd_ring/{ns}/addr/{{}}"
+        ok_key = f"hvd_ring/{ns}/ok/{{}}"
+        self._client = client = _kv_client()
         try:
             if lib is None:
                 raise RuntimeError("native library unavailable")
@@ -126,10 +135,8 @@ class RingBackend(Backend):
                 raise RuntimeError("ring listen failed")
             my_addr = f"{self._my_ip()}:{port}"
         except Exception:
-            try:
-                client.key_value_set(key.format(self.rank), "FAIL")
-            except Exception:
-                pass
+            self._publish(addr_key.format(self.rank), "FAIL")
+            self._publish(ok_key.format(self.rank), "0")
             self.close()
             raise
         try:
@@ -137,25 +144,41 @@ class RingBackend(Backend):
             # store (the same service jax.distributed.initialize stood
             # up — the analog of the reference's rendezvous KV,
             # gloo/gloo_context.cc:63-84).
-            client.key_value_set(key.format(self.rank), my_addr)
+            self._publish(addr_key.format(self.rank), my_addr)
             addrs = [
-                client.blocking_key_value_get(key.format(r), 60_000)
+                client.blocking_key_value_get(addr_key.format(r),
+                                              60_000)
                 for r in range(self.size)
             ]
             if any(a == "FAIL" for a in addrs):
+                self._publish(ok_key.format(self.rank), "0")
                 raise RuntimeError(
                     f"ring setup failed on rank(s) "
                     f"{[r for r, a in enumerate(addrs) if a == 'FAIL']}"
                     "; all ranks use the XLA fallback")
             rc = lib.hvd_ring_connect(self._comm,
                                       ",".join(addrs).encode())
-            if rc != 0:
-                raise RuntimeError(f"ring mesh connect failed (rc={rc})")
+            self._publish(ok_key.format(self.rank),
+                          "1" if rc == 0 else "0")
+            oks = [client.blocking_key_value_get(ok_key.format(r),
+                                                 60_000)
+                   for r in range(self.size)]
+            if rc != 0 or any(o != "1" for o in oks):
+                raise RuntimeError(
+                    f"ring mesh connect failed (rc={rc}, oks={oks}); "
+                    "all ranks use the XLA fallback")
         except Exception:
             self.close()
             raise
         logger.debug("ring backend up: rank %d/%d via %s", self.rank,
                      self.size, my_addr)
+
+    def _publish(self, key: str, value: str):
+        try:
+            self._client.key_value_set(key, value)
+            self._keys.append(key)
+        except Exception:
+            logger.debug("kv publish failed for %s", key, exc_info=True)
 
     @staticmethod
     def _my_ip() -> str:
@@ -178,6 +201,15 @@ class RingBackend(Backend):
         if self._comm is not None:
             self._lib.hvd_ring_destroy(self._comm)
             self._comm = None
+        # Clear rendezvous keys so a later init() against a persistent
+        # jax.distributed client never reads this incarnation's
+        # (now-dead) addresses.
+        keys, self._keys = self._keys, []
+        for key in keys:
+            try:
+                self._client.key_value_delete(key)
+            except Exception:
+                pass
 
     # -- helpers ---------------------------------------------------------
     def _group_args(self, ps_ranks):

@@ -130,8 +130,22 @@ def launch_static(command: List[str],
     server.init({})
 
     all_local = all(is_local(s.hostname) for s in slots)
-    driver_ip = server_ip or (
-        "127.0.0.1" if all_local else local_addresses()[0])
+    if server_ip:
+        driver_ip = server_ip
+    elif all_local:
+        driver_ip = "127.0.0.1"
+    else:
+        # Probe which local address every remote host can actually
+        # reach (reference: runner/driver/driver_service.py NIC
+        # discovery) instead of guessing the first one.
+        from .driver_service import discover_routable_ip
+        remote = sorted({s.hostname for s in slots
+                         if not is_local(s.hostname)})
+        driver_ip = discover_routable_ip(
+            local_addresses(), remote,
+            lambda h, cmd: _ssh_command(h, cmd, ssh_port,
+                                        ssh_identity_file),
+            verbose=verbose) or local_addresses()[0]
     # Rank 0 hosts the jax.distributed coordinator and the negotiation
     # TCP server; remote workers need a routable address for it.  When
     # rank 0 runs on the driver host, the driver's routable IP is that

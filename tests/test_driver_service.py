@@ -1,0 +1,81 @@
+"""Routable-NIC discovery (reference: runner/driver/driver_service.py
+pairwise interface probing).  'Remote' hosts are simulated by running
+the probe client locally through a pass-through shell channel — the
+same command line ssh would carry.  (This sandbox's network loops
+arbitrary IPs back to the local host, so unreachability is simulated
+with closed ports and synthesized host channels, not fake addresses.)
+"""
+
+import socket
+
+from horovod_tpu.runner.driver_service import (ProbeServer,
+                                               discover_routable_ip,
+                                               probe_host)
+
+
+def _local_ip():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _closed_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_probe_host_reaches_live_server():
+    srv = ProbeServer("tok")
+    try:
+        got = probe_host(lambda cmd: cmd, [_local_ip()], srv.port,
+                         "tok")
+    finally:
+        srv.stop()
+    assert got == [_local_ip()]
+
+
+def test_probe_host_rejects_closed_port():
+    got = probe_host(lambda cmd: cmd, [_local_ip()], _closed_port(),
+                     "tok")
+    assert got == []
+
+
+def test_probe_token_guards_against_foreign_server():
+    """A probe against a port answered by some other service must not
+    count as reachable (token mismatch)."""
+    srv = ProbeServer("expected-token")
+    try:
+        got = probe_host(lambda cmd: cmd, [_local_ip()], srv.port,
+                         "wrong-token")
+    finally:
+        srv.stop()
+    assert got == []
+
+
+def test_discover_intersects_across_hosts():
+    """hostA reaches both candidates (real probe), hostB's channel
+    reports only the second — the intersection must pick it."""
+    good = _local_ip()
+
+    def channel(host, cmd):
+        if host == "hostB":
+            return f"echo PROBE_OK {good}"
+        return cmd   # executed locally, as ssh would remotely
+
+    got = discover_routable_ip(["10.99.99.99", good],
+                               ["hostA", "hostB"], channel)
+    assert got == good
+
+
+def test_discover_none_when_nothing_reachable():
+    got = discover_routable_ip([_local_ip()], ["hostA"],
+                               lambda h, cmd: "echo PROBE_OK")
+    assert got is None
