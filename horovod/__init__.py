@@ -45,8 +45,13 @@ class _AliasFinder(importlib.abc.MetaPathFinder):
             "horovod_tpu." + fullname[len("horovod."):]
         try:
             importlib.import_module(impl)
-        except ImportError:
-            return None
+        except ModuleNotFoundError as e:
+            if e.name == impl:
+                return None   # genuinely no such alias target
+            # A missing DEPENDENCY (torch, tensorflow, ...) or a bug
+            # inside the implementation must surface as itself, not as
+            # a bogus "No module named horovod.X".
+            raise
         return importlib.util.spec_from_loader(fullname,
                                                _AliasLoader(impl))
 

@@ -3,6 +3,8 @@ from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
 from .bert import (BertConfig, BertEncoder, BertForMaskedLM,
                    bert_base_config, bert_large_config, bert_tiny_config,
                    mlm_loss)
+from .gpt import (GPTConfig, GPTLMHeadModel, gpt2_medium_config,
+                  gpt2_small_config, gpt_tiny_config, lm_loss)
 from .mnist import MnistCNN, MnistMLP, cross_entropy_loss
 
 __all__ = [
@@ -10,5 +12,7 @@ __all__ = [
     "ResNet152",
     "BertConfig", "BertEncoder", "BertForMaskedLM", "bert_base_config",
     "bert_large_config", "bert_tiny_config", "mlm_loss",
+    "GPTConfig", "GPTLMHeadModel", "gpt2_small_config",
+    "gpt2_medium_config", "gpt_tiny_config", "lm_loss",
     "MnistCNN", "MnistMLP", "cross_entropy_loss",
 ]

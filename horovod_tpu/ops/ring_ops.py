@@ -135,8 +135,12 @@ class RingBackend(Backend):
                 raise RuntimeError("ring listen failed")
             my_addr = f"{self._my_ip()}:{port}"
         except Exception:
-            self._publish(addr_key.format(self.rank), "FAIL")
-            self._publish(ok_key.format(self.rank), "0")
+            # Markers are NOT tracked for deletion: they must outlive
+            # this object so peers' blocking gets observe the demotion
+            # instead of timing out.
+            self._publish(addr_key.format(self.rank), "FAIL",
+                          track=False)
+            self._publish(ok_key.format(self.rank), "0", track=False)
             self.close()
             raise
         try:
@@ -151,7 +155,8 @@ class RingBackend(Backend):
                 for r in range(self.size)
             ]
             if any(a == "FAIL" for a in addrs):
-                self._publish(ok_key.format(self.rank), "0")
+                self._publish(ok_key.format(self.rank), "0",
+                              track=False)
                 raise RuntimeError(
                     f"ring setup failed on rank(s) "
                     f"{[r for r, a in enumerate(addrs) if a == 'FAIL']}"
@@ -173,10 +178,15 @@ class RingBackend(Backend):
         logger.debug("ring backend up: rank %d/%d via %s", self.rank,
                      self.size, my_addr)
 
-    def _publish(self, key: str, value: str):
+    def _publish(self, key: str, value: str, track: bool = True):
+        """allow_overwrite: a crashed incarnation's stale key (never
+        deleted by close) must not block the replacement worker from
+        publishing; a peer that still reads the stale value fails the
+        connect and the unanimous OK round demotes everyone."""
         try:
-            self._client.key_value_set(key, value)
-            self._keys.append(key)
+            self._client.key_value_set(key, value, allow_overwrite=True)
+            if track:
+                self._keys.append(key)
         except Exception:
             logger.debug("kv publish failed for %s", key, exc_info=True)
 

@@ -42,6 +42,27 @@ def bert_partition_rules(tp: str = "tp",
     ]
 
 
+def gpt_partition_rules(tp: str = "tp",
+                        fsdp: Optional[str] = None) -> Rules:
+    """Megatron-style tensor parallelism for the GPT decoder family
+    (models/gpt.py): QKV column-parallel over heads, attention output
+    row-parallel, MLP in column- / out row-parallel, embeddings
+    vocab-sharded (the tied LM head inherits the embedding sharding)."""
+    f = fsdp
+    return [
+        (r"word_embeddings/embedding$", P(tp, f)),
+        (r"position_embeddings/embedding$", P(None, f)),
+        (r"attention/(query|key|value)/kernel$", P(f, tp, None)),
+        (r"attention/(query|key|value)/bias$", P(tp, None)),
+        (r"attention/out/kernel$", P(tp, None, f)),
+        (r"attention/out/bias$", P(None)),
+        (r"intermediate/kernel$", P(f, tp)),
+        (r"intermediate/bias$", P(tp)),
+        (r"(layer_\d+/)output/kernel$", P(tp, f)),
+        (r".*", P()),
+    ]
+
+
 def resnet_partition_rules(fsdp: Optional[str] = None) -> Rules:
     """ResNet is pure data parallel (conv kernels are small); optionally
     ZeRO-shard the dense head."""

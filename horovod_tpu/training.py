@@ -235,3 +235,44 @@ def run_bert_dry_run(n_devices: int, config: Optional[BertConfig] = None,
     state, loss = step_fn(state, batch)
     jax.block_until_ready(loss)
     return float(loss), mesh
+
+
+def run_gpt_dry_run(n_devices: int, batch_size: int = 8,
+                    seq_len: int = 16):
+    """One dp x tp sharded causal-LM training step on an ``n_devices``
+    mesh with tiny shapes (decoder-family multi-chip validation)."""
+    import optax
+    from functools import partial
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .models.gpt import GPTLMHeadModel, gpt_tiny_config, lm_loss
+    from .parallel.mesh import build_mesh
+    from .parallel.sharding import gpt_partition_rules, infer_shardings
+
+    cfg = gpt_tiny_config()
+    axes = factor_mesh_axes(n_devices)
+    dp = axes["dp"] * axes.get("sp", 1)
+    mesh = build_mesh({"dp": dp, "tp": axes.get("tp", 1)})
+    model = GPTLMHeadModel(cfg)
+    # The batch must stay divisible by the dp axis at any device count.
+    batch_size = max(batch_size, 2 * dp)
+    ids = jax.random.randint(jax.random.PRNGKey(0),
+                             (batch_size, seq_len), 0, cfg.vocab_size)
+    ids = jax.device_put(ids, NamedSharding(mesh, P("dp", None)))
+    tx = optax.adam(1e-2)
+    params = model.init(jax.random.PRNGKey(1), ids)["params"]
+    params = jax.tree.map(
+        jax.device_put, params,
+        infer_shardings(params, mesh, gpt_partition_rules()))
+    opt_state = tx.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, ids):
+        def loss_fn(p):
+            return lm_loss(model.apply({"params": p}, ids), ids)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+    return float(loss), mesh
