@@ -107,15 +107,17 @@ class RingBackend(Backend):
         lib = load()
         # The backend choice must be COLLECTIVE: one rank on the ring
         # while another silently falls back to XLA would hang at the
-        # first op. Protocol: (1) every rank publishes its ring address
-        # OR an explicit FAIL marker and anyone seeing a marker aborts
-        # everywhere; (2) after the mesh connect, a second unanimous
-        # OK round catches per-rank connect failures (timeouts), again
-        # demoting everyone together. Keys are namespaced by the
-        # launcher endpoints (shared per incarnation by ALL workers,
-        # including freshly spawned elastic joiners that have no local
-        # init history) and deleted on close so a later re-init against
-        # a persistent jax.distributed client starts clean.
+        # first op. Every rank walks the SAME two rounds regardless of
+        # local failures: (1) publish its ring address or a FAIL
+        # marker, read everyone's; (2) publish connect ok/failed, read
+        # everyone's. Unanimity decides; because even a failing rank
+        # completes both rounds before tearing down, peers observe its
+        # markers promptly (no blocking-get timeout), and every key —
+        # markers included — is deleted at close AFTER the final round,
+        # so the namespace is clean for the next incarnation (keys are
+        # namespaced by the launcher endpoints, which fresh elastic
+        # joiners share; a CRASHED process leaves stale keys, which
+        # allow_overwrite republishing repairs).
         import hashlib
         ns = hashlib.sha1(
             (os.environ.get("HOROVOD_TPU_COORDINATOR", "") + "|" +
@@ -124,7 +126,14 @@ class RingBackend(Backend):
         addr_key = f"hvd_ring/{ns}/addr/{{}}"
         ok_key = f"hvd_ring/{ns}/ok/{{}}"
         self._client = client = _kv_client()
+        my_addr = None
+        err = None
         try:
+            if os.environ.get("HOROVOD_RING_TEST_FAIL_RANK") == \
+                    str(self.rank):
+                # Test-only fault injection: exercises the unanimous
+                # demotion protocol (see tests/test_ring_backend.py).
+                raise RuntimeError("test-injected ring failure")
             if lib is None:
                 raise RuntimeError("native library unavailable")
             _bind(lib)
@@ -134,59 +143,49 @@ class RingBackend(Backend):
             if port <= 0:
                 raise RuntimeError("ring listen failed")
             my_addr = f"{self._my_ip()}:{port}"
-        except Exception:
-            # Markers are NOT tracked for deletion: they must outlive
-            # this object so peers' blocking gets observe the demotion
-            # instead of timing out.
-            self._publish(addr_key.format(self.rank), "FAIL",
-                          track=False)
-            self._publish(ok_key.format(self.rank), "0", track=False)
-            self.close()
-            raise
+        except Exception as e:
+            err = e
         try:
-            # Address exchange over the jax coordination-service KV
-            # store (the same service jax.distributed.initialize stood
-            # up — the analog of the reference's rendezvous KV,
-            # gloo/gloo_context.cc:63-84).
-            self._publish(addr_key.format(self.rank), my_addr)
+            # Round 1: address exchange over the jax coordination-
+            # service KV store (the analog of the reference's
+            # rendezvous KV, gloo/gloo_context.cc:63-84).
+            self._publish(addr_key.format(self.rank),
+                          my_addr if err is None else "FAIL")
             addrs = [
                 client.blocking_key_value_get(addr_key.format(r),
                                               60_000)
                 for r in range(self.size)
             ]
-            if any(a == "FAIL" for a in addrs):
-                self._publish(ok_key.format(self.rank), "0",
-                              track=False)
-                raise RuntimeError(
-                    f"ring setup failed on rank(s) "
-                    f"{[r for r, a in enumerate(addrs) if a == 'FAIL']}"
-                    "; all ranks use the XLA fallback")
-            rc = lib.hvd_ring_connect(self._comm,
-                                      ",".join(addrs).encode())
+            rc = -1
+            if err is None and not any(a == "FAIL" for a in addrs):
+                rc = lib.hvd_ring_connect(self._comm,
+                                          ",".join(addrs).encode())
+            # Round 2: unanimous connect outcome.
             self._publish(ok_key.format(self.rank),
                           "1" if rc == 0 else "0")
             oks = [client.blocking_key_value_get(ok_key.format(r),
                                                  60_000)
                    for r in range(self.size)]
+            if err is not None:
+                raise err
             if rc != 0 or any(o != "1" for o in oks):
                 raise RuntimeError(
-                    f"ring mesh connect failed (rc={rc}, oks={oks}); "
-                    "all ranks use the XLA fallback")
+                    f"ring setup incomplete (rc={rc}, oks={oks}, "
+                    f"addrs={addrs}); all ranks use the XLA fallback")
         except Exception:
             self.close()
             raise
         logger.debug("ring backend up: rank %d/%d via %s", self.rank,
                      self.size, my_addr)
 
-    def _publish(self, key: str, value: str, track: bool = True):
-        """allow_overwrite: a crashed incarnation's stale key (never
-        deleted by close) must not block the replacement worker from
-        publishing; a peer that still reads the stale value fails the
-        connect and the unanimous OK round demotes everyone."""
+    def _publish(self, key: str, value: str):
+        """allow_overwrite: a crashed incarnation's stale key must not
+        block a replacement worker from publishing; a peer that still
+        reads a stale value fails the connect and the unanimous OK
+        round demotes everyone consistently."""
         try:
             self._client.key_value_set(key, value, allow_overwrite=True)
-            if track:
-                self._keys.append(key)
+            self._keys.append(key)
         except Exception:
             logger.debug("kv publish failed for %s", key, exc_info=True)
 

@@ -19,16 +19,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Rules = Sequence[Tuple[str, P]]
 
 
-def bert_partition_rules(tp: str = "tp",
-                         fsdp: Optional[str] = None) -> Rules:
-    """Megatron-style tensor-parallel sharding for the flax BERT family:
-    QKV projections column-parallel over heads, output row-parallel, MLP
-    in column- / out row-parallel, embeddings vocab-sharded."""
+def _transformer_partition_rules(tp: str, fsdp: Optional[str],
+                                 extra: Rules = ()) -> Rules:
+    """Megatron-style tensor parallelism shared by both transformer
+    families (models/bert.py naming == models/gpt.py naming): QKV
+    projections column-parallel over heads, attention output
+    row-parallel, MLP in column- / out row-parallel, embeddings
+    vocab-sharded.  ``extra`` prepends family-specific rules."""
     f = fsdp  # optional second sharding axis (ZeRO-3 style)
     return [
+        *extra,
         (r"word_embeddings/embedding$", P(tp, f)),
         (r"position_embeddings/embedding$", P(None, f)),
-        (r"token_type_embeddings/embedding$", P(None, f)),
         (r"attention/(query|key|value)/kernel$", P(f, tp, None)),
         (r"attention/(query|key|value)/bias$", P(tp, None)),
         (r"attention/out/kernel$", P(tp, None, f)),
@@ -36,31 +38,25 @@ def bert_partition_rules(tp: str = "tp",
         (r"intermediate/kernel$", P(f, tp)),
         (r"intermediate/bias$", P(tp)),
         (r"(layer_\d+/)output/kernel$", P(tp, f)),
-        (r"mlm_transform/kernel$", P(None, f)),
-        (r"mlm_bias$", P(tp)),
         (r".*", P()),  # everything else (norms, small biases) replicated
     ]
 
 
+def bert_partition_rules(tp: str = "tp",
+                         fsdp: Optional[str] = None) -> Rules:
+    """Tensor-parallel sharding for the flax BERT encoder family."""
+    return _transformer_partition_rules(tp, fsdp, extra=[
+        (r"token_type_embeddings/embedding$", P(None, fsdp)),
+        (r"mlm_transform/kernel$", P(None, fsdp)),
+        (r"mlm_bias$", P(tp)),
+    ])
+
+
 def gpt_partition_rules(tp: str = "tp",
                         fsdp: Optional[str] = None) -> Rules:
-    """Megatron-style tensor parallelism for the GPT decoder family
-    (models/gpt.py): QKV column-parallel over heads, attention output
-    row-parallel, MLP in column- / out row-parallel, embeddings
-    vocab-sharded (the tied LM head inherits the embedding sharding)."""
-    f = fsdp
-    return [
-        (r"word_embeddings/embedding$", P(tp, f)),
-        (r"position_embeddings/embedding$", P(None, f)),
-        (r"attention/(query|key|value)/kernel$", P(f, tp, None)),
-        (r"attention/(query|key|value)/bias$", P(tp, None)),
-        (r"attention/out/kernel$", P(tp, None, f)),
-        (r"attention/out/bias$", P(None)),
-        (r"intermediate/kernel$", P(f, tp)),
-        (r"intermediate/bias$", P(tp)),
-        (r"(layer_\d+/)output/kernel$", P(tp, f)),
-        (r".*", P()),
-    ]
+    """Tensor-parallel sharding for the GPT decoder family (the tied
+    LM head inherits the embedding's vocab sharding)."""
+    return _transformer_partition_rules(tp, fsdp)
 
 
 def resnet_partition_rules(fsdp: Optional[str] = None) -> Rules:

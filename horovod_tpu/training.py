@@ -237,42 +237,58 @@ def run_bert_dry_run(n_devices: int, config: Optional[BertConfig] = None,
     return float(loss), mesh
 
 
-def run_gpt_dry_run(n_devices: int, batch_size: int = 8,
-                    seq_len: int = 16):
-    """One dp x tp sharded causal-LM training step on an ``n_devices``
-    mesh with tiny shapes (decoder-family multi-chip validation)."""
+def make_gpt_train_step(config, mesh, learning_rate: float = 1e-2):
+    """Sharded dp x tp causal-LM training step for the GPT family —
+    the decoder counterpart of make_bert_pretrain_step. Returns
+    (init_fn, step_fn, batch_sharding); params/opt state are annotated
+    with gpt_partition_rules and XLA inserts the collectives."""
     import optax
     from functools import partial
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from .models.gpt import GPTLMHeadModel, gpt_tiny_config, lm_loss
-    from .parallel.mesh import build_mesh
+    from .models.gpt import GPTLMHeadModel, lm_loss
     from .parallel.sharding import gpt_partition_rules, infer_shardings
 
-    cfg = gpt_tiny_config()
-    axes = factor_mesh_axes(n_devices)
-    dp = axes["dp"] * axes.get("sp", 1)
-    mesh = build_mesh({"dp": dp, "tp": axes.get("tp", 1)})
-    model = GPTLMHeadModel(cfg)
-    # The batch must stay divisible by the dp axis at any device count.
-    batch_size = max(batch_size, 2 * dp)
-    ids = jax.random.randint(jax.random.PRNGKey(0),
-                             (batch_size, seq_len), 0, cfg.vocab_size)
-    ids = jax.device_put(ids, NamedSharding(mesh, P("dp", None)))
-    tx = optax.adam(1e-2)
-    params = model.init(jax.random.PRNGKey(1), ids)["params"]
-    params = jax.tree.map(
-        jax.device_put, params,
-        infer_shardings(params, mesh, gpt_partition_rules()))
-    opt_state = tx.init(params)
+    model = GPTLMHeadModel(config)
+    tx = optax.adam(learning_rate)
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+
+    def init_fn(rng, ids):
+        params = model.init(rng, ids)["params"]
+        params = jax.tree.map(
+            jax.device_put, params,
+            infer_shardings(params, mesh, gpt_partition_rules()))
+        return params, tx.init(params)
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, ids):
+    def step_fn(params, opt_state, ids):
         def loss_fn(p):
             return lm_loss(model.apply({"params": p}, ids), ids)
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    params, opt_state, loss = step(params, opt_state, ids)
+    return init_fn, step_fn, batch_sharding
+
+
+def run_gpt_dry_run(n_devices: int, batch_size: int = 8,
+                    seq_len: int = 16):
+    """One dp x tp sharded causal-LM training step on an ``n_devices``
+    mesh with tiny shapes (decoder-family multi-chip validation)."""
+    from .models.gpt import gpt_tiny_config
+    from .parallel.mesh import build_mesh
+
+    cfg = gpt_tiny_config()
+    axes = factor_mesh_axes(n_devices)
+    dp = axes["dp"] * axes.get("sp", 1)
+    mesh = build_mesh({"dp": dp, "tp": axes.get("tp", 1)})
+    # Round the batch UP to a multiple of the dp axis so sharding
+    # divides at any device count (dp=3 must not see batch 8).
+    batch_size = -(-max(batch_size, 2 * dp) // dp) * dp
+    ids = jax.random.randint(jax.random.PRNGKey(0),
+                             (batch_size, seq_len), 0, cfg.vocab_size)
+    init_fn, step_fn, batch_sharding = make_gpt_train_step(cfg, mesh)
+    ids = jax.device_put(ids, batch_sharding)
+    params, opt_state = init_fn(jax.random.PRNGKey(1), ids)
+    params, opt_state, loss = step_fn(params, opt_state, ids)
     jax.block_until_ready(loss)
     return float(loss), mesh

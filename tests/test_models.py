@@ -172,40 +172,22 @@ def test_gpt_sharding_rules_applied():
 
 
 def test_gpt_sharded_train_step_loss_decreases():
-    """Full dp x tp sharded LM training step on the virtual mesh."""
-    import optax
-    from functools import partial
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from horovod_tpu.models import (GPTLMHeadModel, gpt_tiny_config,
-                                    lm_loss)
+    """Full dp x tp sharded LM training step on the virtual mesh
+    (shared make_gpt_train_step infrastructure)."""
+    from horovod_tpu.models import gpt_tiny_config
     from horovod_tpu.parallel.mesh import build_mesh
-    from horovod_tpu.parallel.sharding import (gpt_partition_rules,
-                                               infer_shardings)
+    from horovod_tpu.training import make_gpt_train_step
 
     cfg = gpt_tiny_config()
     mesh = build_mesh({"dp": 4, "tp": 2})
-    model = GPTLMHeadModel(cfg)
+    init_fn, step_fn, batch_sharding = make_gpt_train_step(
+        cfg, mesh, learning_rate=1e-2)
     ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0,
                              cfg.vocab_size)
-    batch_sharding = NamedSharding(mesh, P("dp", None))
     ids = jax.device_put(ids, batch_sharding)
-
-    tx = optax.adam(1e-2)
-    params = model.init(jax.random.PRNGKey(1), ids)["params"]
-    shardings = infer_shardings(params, mesh, gpt_partition_rules())
-    params = jax.tree.map(jax.device_put, params, shardings)
-    opt_state = tx.init(params)
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, ids):
-        def loss_fn(p):
-            return lm_loss(model.apply({"params": p}, ids), ids)
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
+    params, opt_state = init_fn(jax.random.PRNGKey(1), ids)
     losses = []
     for _ in range(8):
-        params, opt_state, loss = step(params, opt_state, ids)
+        params, opt_state, loss = step_fn(params, opt_state, ids)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses

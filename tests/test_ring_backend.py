@@ -124,3 +124,25 @@ np.testing.assert_allclose(np.asarray(y), 3.0)
 print("OK")
 """, nproc=2)
     assert_all_ok(results)
+
+
+def test_ring_failure_demotes_all_ranks_together():
+    """One rank failing ring setup must demote EVERY rank to the XLA
+    fallback promptly (unanimous two-round agreement) — mixed backends
+    would deadlock at the first collective."""
+    import time
+    t0 = time.monotonic()
+    results = run_workers("""
+from horovod_tpu.common import basics
+assert type(basics._state().backend).__name__ == "XlaMeshBackend", \\
+    type(basics._state().backend)
+y = np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                             name="t"))
+np.testing.assert_allclose(y, SIZE)
+print("OK")
+""", nproc=3, timeout=240,
+        extra_env={"HOROVOD_RING_TEST_FAIL_RANK": "1"})
+    assert_all_ok(results)
+    # Prompt demotion: the healthy ranks observed the FAIL marker via
+    # the agreement rounds instead of waiting out a 60s KV timeout.
+    assert time.monotonic() - t0 < 120
