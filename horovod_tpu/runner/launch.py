@@ -65,6 +65,11 @@ def parse_args(argv=None):
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     parser.add_argument("-v", "--version", action="version",
                         version=__version__)
+    parser.add_argument("-cb", "--check-build", action="store_true",
+                        dest="check_build",
+                        help="Print available frameworks, controllers "
+                             "and tensor operations, then exit "
+                             "(reference: horovodrun --check-build).")
     parser.add_argument("-np", "--num-proc", dest="np", type=int,
                         help="Total number of worker processes.")
     parser.add_argument("--disable-cache", action="store_true",
@@ -315,8 +320,47 @@ def _run(args):
     return _run_static(args)
 
 
+def check_build():
+    """Build/availability report (reference: launch.py:116-153
+    check_build — frameworks, controllers, tensor operations)."""
+    from .. import __version__
+
+    def have(modname):
+        import importlib.util
+        try:
+            return importlib.util.find_spec(modname) is not None
+        except (ImportError, ValueError):
+            return False
+
+    def x(v):
+        return "X" if v else " "
+
+    from ..native import available as native_available
+    print(f"""\
+Horovod-TPU v{__version__}:
+
+Available Frameworks:
+    [{x(have('jax'))}] JAX
+    [{x(have('tensorflow'))}] TensorFlow
+    [{x(have('torch'))}] PyTorch
+    [{x(have('keras'))}] Keras
+    [ ] MXNet (descoped; docs/mxnet_descope.md)
+
+Available Controllers:
+    [{x(True)}] TCP (Python coordinator)
+    [{x(native_available())}] TCP (native C++ coordinator)
+
+Available Tensor Operations:
+    [{x(have('jax'))}] XLA (ICI mesh collectives)
+    [{x(native_available())}] RING (native CPU TCP ring)
+    [{x(have('jax'))}] Gloo (jax CPU cross-process)""")
+
+
 def run_commandline():
     args = parse_args()
+    if args.check_build:
+        check_build()
+        return
     if not args.command:
         print("horovodrun: no command given; see horovodrun -h",
               file=sys.stderr)

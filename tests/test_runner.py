@@ -173,3 +173,16 @@ def test_rendezvous_wait_get():
             client.wait_get("s", "never", timeout=0.3)
     finally:
         server.stop()
+
+
+def test_check_build_flag(capsys):
+    import sys
+    from unittest import mock
+    from horovod_tpu.runner import launch
+    with mock.patch.object(sys, "argv", ["horovodrun", "--check-build"]):
+        launch.run_commandline()
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "Available Controllers" in out
+    assert "RING" in out
