@@ -146,3 +146,23 @@ print("OK")
     # Prompt demotion: the healthy ranks observed the FAIL marker via
     # the agreement rounds instead of waiting out a 60s KV timeout.
     assert time.monotonic() - t0 < 120
+
+
+def test_ring_survives_shutdown_reinit():
+    """Elastic resets shutdown+init in-process with the same launcher
+    endpoints: the ring must come back (keys were deleted at close, so
+    the second incarnation's rendezvous starts clean)."""
+    results = run_workers(_RING_CHECK + """
+y = np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                             name="a"))
+np.testing.assert_allclose(y, SIZE)
+hvd.shutdown()
+hvd.init()
+state = basics._state()
+assert type(state.backend).__name__ == "RingBackend", type(state.backend)
+y = np.asarray(hvd.allreduce(np.full(4, 2.0, np.float32), op=hvd.Sum,
+                             name="b"))
+np.testing.assert_allclose(y, 2.0 * SIZE)
+print("REINIT OK")
+""", nproc=2, timeout=240)
+    assert_all_ok(results)
