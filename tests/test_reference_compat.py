@@ -88,6 +88,8 @@ def test_alias_package_surface():
     import horovod.tensorflow.keras as htk
     import horovod.keras as hk
     import horovod.spark as hs
+    import horovod.spark.keras as hsk
+    import horovod.spark.torch as hst
     import horovod.ray as hr
     import horovod.elastic as he
 
@@ -100,6 +102,8 @@ def test_alias_package_surface():
             (htk, ["DistributedOptimizer", "callbacks"]),
             (hk, ["DistributedOptimizer", "callbacks"]),
             (hs, ["run", "Store", "FilesystemStore"]),
+            (hsk, ["KerasEstimator", "KerasModel"]),
+            (hst, ["TorchEstimator", "TorchModel"]),
             (hr, ["RayExecutor"]),
             (he, ["State", "run_fn"]),
     ]:
