@@ -1,0 +1,79 @@
+"""Elastic PyTorch training over Ray hosts (reference:
+examples/ray/pytorch_ray_elastic.py — ``ElasticRayExecutor`` discovers
+slots from the Ray cluster/autoscaler and drives the elastic launcher,
+so the worker script is plain elastic Horovod code).
+
+The executor launches this same file's ``--worker`` mode on every
+discovered slot; workers join/leave as the Ray cluster grows/shrinks.
+
+Run:  python pytorch_ray_elastic.py --min-np 1 --max-np 4
+"""
+
+import argparse
+import sys
+
+
+def worker():
+    import torch
+    import torch.nn.functional as F
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(32, 64), torch.nn.ReLU(),
+        torch.nn.Linear(64, 10))
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=0.01 * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    data = torch.randn(64, 32)
+    target = torch.randint(0, 10, (64,))
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < 50:
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(data), target)
+            loss.backward()
+            optimizer.step()
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.commit()
+                if hvd.rank() == 0:
+                    print(f"batch {state.batch} size {hvd.size()} "
+                          f"loss {loss.item():.4f}", flush=True)
+
+    state = hvd.elastic.TorchState(model, optimizer, batch=0)
+    train(state)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true",
+                        help="internal: run as a training worker")
+    parser.add_argument("--min-np", type=int, default=1)
+    parser.add_argument("--max-np", type=int, default=4)
+    parser.add_argument("--cpus-per-slot", type=int, default=1)
+    args = parser.parse_args()
+
+    if args.worker:
+        worker()
+        return
+
+    import ray
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    ray.init()
+    executor = ElasticRayExecutor(
+        min_np=args.min_np, max_np=args.max_np,
+        cpus_per_slot=args.cpus_per_slot)
+    executor.run_command(
+        [sys.executable, __file__, "--worker"])
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,14 @@
 //                            const int* ranks, int nranks);
 //   int   hvd_ring_broadcast(void*, void* buf, long long nbytes,
 //                            int root, const int* ranks, int nranks);
+//   int   hvd_ring_alltoall(void*, const void* inbuf, void* outbuf,
+//                           const long long* sendcounts_bytes,
+//                           const long long* recvcounts_bytes,
+//                           const int* ranks, int nranks);
+//   int   hvd_ring_reducescatter(void*, void* buf,
+//                                const long long* counts /*elements*/,
+//                                int dtype, int op, void* outbuf,
+//                                const int* ranks, int nranks);
 //   int   hvd_ring_barrier(void*, const int* ranks, int nranks);
 //   void  hvd_ring_destroy(void*);
 //
@@ -406,6 +414,99 @@ int hvd_ring_broadcast(void* h, void* buf, long long nbytes, int root,
         return -4;
     }
   }
+  return 0;
+}
+
+// Pairwise-exchange alltoall with uneven byte counts — the semantics
+// of MPI_Alltoallv (reference: operations.cc:1099-1160 alltoall with
+// splits, ops/mpi_operations.cc MPIAlltoall). sendcounts[i] bytes from
+// inbuf go to group rank i; recvcounts[i] bytes from group rank i land
+// in outbuf; both buffers are packed in group order. Pure data
+// movement: dtype-agnostic.
+//
+// Schedule: at step s, send to (me+s)%p while receiving from (me-s)%p.
+// Each ordered pair (a -> b) is touched in exactly one step
+// (s = b-a mod p), so per-socket streams never interleave even though
+// ranks drift across steps.
+int hvd_ring_alltoall(void* h, const void* inbuf, void* outbuf,
+                      const long long* sendcounts,
+                      const long long* recvcounts,
+                      const int* ranks, int nranks) {
+  auto* c = static_cast<RingComm*>(h);
+  std::vector<int> group;
+  int me = group_index(c, ranks, nranks, &group);
+  if (me < 0) return -1;
+  int p = static_cast<int>(group.size());
+  std::vector<int64_t> soff(p + 1, 0), roff(p + 1, 0);
+  for (int i = 0; i < p; ++i) {
+    soff[i + 1] = soff[i] + sendcounts[i];
+    roff[i + 1] = roff[i] + recvcounts[i];
+  }
+  const char* in = static_cast<const char*>(inbuf);
+  char* out = static_cast<char*>(outbuf);
+  if (sendcounts[me] > 0)
+    std::memcpy(out + roff[me], in + soff[me],
+                static_cast<size_t>(sendcounts[me]));
+  for (int s = 1; s < p; ++s) {
+    int to = (me + s) % p;
+    int from = (me - s + p) % p;
+    int sfd = c->fds[group[to]];
+    int rfd = c->fds[group[from]];
+    if (sfd < 0 || rfd < 0) return -3;
+    if (!send_recv(sfd, in + soff[to],
+                   static_cast<size_t>(sendcounts[to]), rfd,
+                   out + roff[from],
+                   static_cast<size_t>(recvcounts[from])))
+      return -4;
+  }
+  return 0;
+}
+
+// Ring reduce-scatter with per-rank element counts: after p-1 steps
+// group rank i holds the full reduction of chunk i (copied to outbuf).
+// One ring pass — half the bandwidth of allreduce-then-slice (the
+// building block the reference uses inside NCCLHierarchicalAllreduce,
+// ops/nccl_operations.cc:188-360; first-class here per SURVEY §2.3's
+// FSDP row). buf is scratch and is clobbered.
+int hvd_ring_reducescatter(void* h, void* buf, const long long* counts,
+                           int dtype, int op, void* outbuf,
+                           const int* ranks, int nranks) {
+  auto* c = static_cast<RingComm*>(h);
+  std::vector<int> group;
+  int me = group_index(c, ranks, nranks, &group);
+  if (me < 0) return -1;
+  int p = static_cast<int>(group.size());
+  size_t es = dtype_size(dtype);
+  if (es == 0) return -2;
+  std::vector<int64_t> off(p + 1, 0);
+  for (int i = 0; i < p; ++i) off[i + 1] = off[i] + counts[i];
+  char* base = static_cast<char*>(buf);
+  if (p == 1) {
+    std::memcpy(outbuf, base, static_cast<size_t>(counts[0]) * es);
+    return 0;
+  }
+  int right = c->fds[group[(me + 1) % p]];
+  int left = c->fds[group[(me - 1 + p) % p]];
+  if (right < 0 || left < 0) return -3;
+  int64_t max_chunk = 0;
+  for (int i = 0; i < p; ++i)
+    max_chunk = std::max(max_chunk, static_cast<int64_t>(counts[i]));
+  std::vector<char> tmp(static_cast<size_t>(max_chunk) * es);
+  // Chunk (me-s-1) was accumulated in the previous step and moves on;
+  // the final receive at s = p-2 lands chunk `me` fully reduced here.
+  for (int s = 0; s < p - 1; ++s) {
+    int send_c = ((me - s - 1) % p + p) % p;
+    int recv_c = ((me - s - 2) % p + p) % p;
+    int64_t sn = counts[send_c];
+    int64_t rn = counts[recv_c];
+    if (!send_recv(right, base + off[send_c] * es,
+                   static_cast<size_t>(sn) * es, left, tmp.data(),
+                   static_cast<size_t>(rn) * es))
+      return -4;
+    reduce_buf(base + off[recv_c] * es, tmp.data(), rn, dtype, op);
+  }
+  std::memcpy(outbuf, base + off[me] * es,
+              static_cast<size_t>(counts[me]) * es);
   return 0;
 }
 

@@ -7,8 +7,8 @@ XLA collectives over ICI (:mod:`.xla_ops`); on CPU rigs, dispatching a
 multi-controller XLA program costs milliseconds per call, while the
 native ring over persistent sockets costs microseconds — so this
 backend owns the host-tensor hot path (allreduce/allgather/broadcast/
-barrier) and delegates everything else (alltoall, reducescatter,
-Adasum, exotic dtypes) to the XLA backend.
+alltoall/reducescatter/barrier) and delegates the rest (Adasum,
+complex dtypes) to the XLA backend.
 
 Selection (reference knob HOROVOD_CPU_OPERATIONS, common.h:84-89):
 ``HOROVOD_CPU_OPERATIONS=RING`` (default on CPU) or ``XLA``.
@@ -69,6 +69,17 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_ring_alltoall.restype = ctypes.c_int
+    lib.hvd_ring_alltoall.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_ring_reducescatter.restype = ctypes.c_int
+    lib.hvd_ring_reducescatter.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
     lib.hvd_ring_broadcast.restype = ctypes.c_int
     lib.hvd_ring_broadcast.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
@@ -350,12 +361,114 @@ class RingBackend(Backend):
             out.append(self._rewrap(a, wj))
         return out
 
-    # -- delegated ops ---------------------------------------------------
-    def alltoall(self, array, splits, ps_ranks=()):
-        return self.fallback.alltoall(array, splits, ps_ranks)
+    # -- alltoall --------------------------------------------------------
+    def _my_index(self, ps_ranks) -> int:
+        return ps_ranks.index(self.rank) if ps_ranks else self.rank
 
+    def alltoall(self, array, splits, ps_ranks=()):
+        """Pairwise-exchange alltoall over the native mesh, matching the
+        XLA backend's semantics (splits = dim-0 row counts per
+        destination; returns (output, recv_splits) — reference
+        operations.cc:1099-1160, AlltoallGetRecvSplits
+        mpi_controller.cc:212-223). Pure data movement, so any dtype
+        goes over the wire as raw bytes."""
+        ps_ranks = tuple(ps_ranks)
+        ranks_arr, nranks, gsize = self._group_args(ps_ranks)
+        my_idx = self._my_index(ps_ranks)
+        wj = self._is_jax(array)
+        a = np.ascontiguousarray(np.asarray(array))
+        if a.ndim == 0:
+            a = a[None]
+        if splits is None:
+            base, rem = divmod(a.shape[0], gsize)
+            splits = np.array([base + (1 if r < rem else 0)
+                               for r in range(gsize)], dtype=np.int64)
+        splits = np.ascontiguousarray(np.asarray(splits, np.int64))
+        # Validate before anything reaches native code: a bad splits
+        # vector must be a Python error, not an OOB read/write in C.
+        if splits.shape != (gsize,):
+            raise ValueError(
+                f"splits must have one entry per group rank "
+                f"({gsize}), got shape {splits.shape}")
+        if (splits < 0).any() or int(splits.sum()) != a.shape[0]:
+            raise ValueError(
+                f"splits must be non-negative and sum to the first "
+                f"dimension ({a.shape[0]}), got {splits.tolist()}")
+        # Split-matrix exchange (small): recv splits are column my_idx.
+        mat = np.empty(gsize * gsize, np.int64)
+        counts8 = (ctypes.c_longlong * gsize)(*([8 * gsize] * gsize))
+        rc = self._lib.hvd_ring_allgather(
+            self._comm, splits.ctypes.data_as(ctypes.c_void_p),
+            splits.nbytes, mat.ctypes.data_as(ctypes.c_void_p),
+            counts8, ranks_arr, nranks)
+        if rc != 0:
+            raise RuntimeError(f"ring alltoall splits failed (rc={rc})")
+        recv_splits = mat.reshape(gsize, gsize)[:, my_idx].copy()
+
+        row_bytes = a.dtype.itemsize * int(np.prod(a.shape[1:],
+                                                   initial=1))
+        sendcounts = (ctypes.c_longlong * gsize)(
+            *[int(s) * row_bytes for s in splits])
+        recvcounts = (ctypes.c_longlong * gsize)(
+            *[int(s) * row_bytes for s in recv_splits])
+        out = np.empty((int(recv_splits.sum()),) + a.shape[1:], a.dtype)
+        rc = self._lib.hvd_ring_alltoall(
+            self._comm, a.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), sendcounts, recvcounts,
+            ranks_arr, nranks)
+        if rc != 0:
+            raise RuntimeError(f"ring alltoall failed (rc={rc})")
+        self.stats["ring_alltoalls"] = \
+            self.stats.get("ring_alltoalls", 0) + 1
+        return self._rewrap(out, wj), recv_splits
+
+    # -- reducescatter ---------------------------------------------------
     def reducescatter(self, arrays, reduce_op, ps_ranks=()):
-        return self.fallback.reducescatter(arrays, reduce_op, ps_ranks)
+        """One ring pass per fused batch — half the bandwidth of
+        allreduce-then-slice. Uneven dim-0 split convention matches the
+        XLA backend (first ranks absorb the remainder)."""
+        if reduce_op not in _OPS:
+            return self.fallback.reducescatter(arrays, reduce_op,
+                                               ps_ranks)
+        ps_ranks = tuple(ps_ranks)
+        ranks_arr, nranks, gsize = self._group_args(ps_ranks)
+        my_idx = self._my_index(ps_ranks)
+        out = []
+        for x in arrays:
+            wj = self._is_jax(x)
+            a = np.asarray(x)
+            orig_dt = a.dtype
+            work_dt = np.dtype(_UPCAST.get(a.dtype, a.dtype))
+            if work_dt not in _DTYPES or a.ndim == 0 or \
+                    np.iscomplexobj(a):
+                res = self.fallback.reducescatter([x], reduce_op,
+                                                  ps_ranks)[0]
+                out.append(res)
+                continue
+            buf = np.ascontiguousarray(a, dtype=work_dt)
+            if buf is a or buf.base is not None:
+                buf = buf.copy()  # scratch is clobbered by the ring
+            row_elems = int(np.prod(a.shape[1:], initial=1))
+            base, rem = divmod(a.shape[0], gsize)
+            rows = [base + (1 if r < rem else 0) for r in range(gsize)]
+            counts = (ctypes.c_longlong * gsize)(
+                *[r * row_elems for r in rows])
+            res = np.empty((rows[my_idx],) + a.shape[1:], work_dt)
+            rc = self._lib.hvd_ring_reducescatter(
+                self._comm, buf.ctypes.data_as(ctypes.c_void_p),
+                counts, _DTYPES[work_dt], _OPS[reduce_op],
+                res.ctypes.data_as(ctypes.c_void_p), ranks_arr, nranks)
+            if rc != 0:
+                raise RuntimeError(
+                    f"ring reducescatter failed (rc={rc})")
+            if reduce_op == "Average":
+                res = self._scale(res, 1.0 / gsize)
+            if res.dtype != orig_dt:
+                res = res.astype(orig_dt)
+            out.append(self._rewrap(res, wj))
+            self.stats["ring_reducescatters"] = \
+                self.stats.get("ring_reducescatters", 0) + 1
+        return out
 
     def barrier(self, ps_ranks=()):
         ranks_arr, nranks, _ = self._group_args(tuple(ps_ranks))

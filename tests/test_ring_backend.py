@@ -80,6 +80,97 @@ print("OK")
     assert_all_ok(results)
 
 
+def test_ring_alltoall_reducescatter_nproc3():
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+
+# Uneven alltoall: rank r sends r+d+1 rows to destination d. Row r of
+# the split matrix is rank r's send vector; rank me receives column me.
+splits = np.array([RANK + d + 1 for d in range(SIZE)], np.int64)
+x = np.concatenate([
+    np.full((int(s), 2), 10.0 * RANK + d, np.float32)
+    for d, s in enumerate(splits)])
+out, rsplits = hvd.alltoall(x, splits=splits, name="a2a")
+out = np.asarray(out)
+exp_rsplits = np.array([r + RANK + 1 for r in range(SIZE)], np.int64)
+np.testing.assert_array_equal(np.asarray(rsplits), exp_rsplits)
+off = 0
+for r, s in enumerate(exp_rsplits):
+    np.testing.assert_allclose(out[off:off + s], 10.0 * r + RANK)
+    off += int(s)
+assert out.shape == (int(exp_rsplits.sum()), 2), out.shape
+
+# Even alltoall with splits=None (rows divisible by SIZE)
+y = np.asarray(hvd.alltoall(
+    np.repeat(np.arange(SIZE, dtype=np.float32), 2)[:, None],
+    name="a2a_even"))
+np.testing.assert_allclose(y.ravel(), np.repeat(float(RANK), 2 * SIZE))
+
+# int alltoall rides the same raw-bytes path
+z, _ = hvd.alltoall(np.full((SIZE, 1), RANK, np.int64),
+                    splits=np.ones(SIZE, np.int64), name="a2a_int")
+np.testing.assert_array_equal(np.asarray(z).ravel(), np.arange(SIZE))
+
+# reducescatter: 7 rows over 3 ranks -> counts (3, 2, 2)
+rows = 2 * SIZE + 1
+x = np.tile(np.arange(rows, dtype=np.float32)[:, None], (1, 3))
+mine = np.asarray(hvd.reducescatter(x, op=hvd.Sum, name="rs"))
+base, rem = divmod(rows, SIZE)
+counts = [base + (1 if r < rem else 0) for r in range(SIZE)]
+start = sum(counts[:RANK])
+exp = SIZE * np.tile(
+    np.arange(start, start + counts[RANK], dtype=np.float32)[:, None],
+    (1, 3))
+np.testing.assert_allclose(mine, exp)
+assert mine.shape == (counts[RANK], 3), mine.shape
+
+# Average + f16 upcast path
+m = np.asarray(hvd.reducescatter(
+    np.full((SIZE, 4), float(RANK + 1), np.float16), op=hvd.Average,
+    name="rs_avg"))
+np.testing.assert_allclose(m.astype(np.float64),
+                           (SIZE + 1) / 2.0, rtol=1e-3)
+
+# A bad splits vector is a Python error before any native call
+# (not an OOB read/write in C).
+err = None
+try:
+    hvd.alltoall(np.zeros((4, 1), np.float32),
+                 splits=np.full(SIZE, 2, np.int64), name="a2a_bad")
+except Exception as e:
+    err = e
+assert err is not None and "sum to the first" in str(err), err
+
+# Both ops ran on the native ring, not the XLA fallback.
+assert state.backend.stats.get("ring_alltoalls", 0) >= 3, \
+    state.backend.stats
+assert state.backend.stats.get("ring_reducescatters", 0) >= 2, \
+    state.backend.stats
+print("OK")
+""", nproc=3, timeout=240)
+    assert_all_ok(results)
+
+
+def test_ring_alltoall_process_set():
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+ps = hvd.add_process_set([0, 2])
+if RANK in (0, 2):
+    out, rsplits = hvd.alltoall(
+        np.full((2, 1), float(RANK), np.float32),
+        splits=np.ones(2, np.int64), name="ps_a2a", process_set=ps)
+    np.testing.assert_array_equal(np.asarray(rsplits), [1, 1])
+    np.testing.assert_allclose(np.asarray(out).ravel(), [0.0, 2.0])
+    mine = np.asarray(hvd.reducescatter(
+        np.ones((2, 2), np.float32), op=hvd.Sum, name="ps_rs",
+        process_set=ps))
+    np.testing.assert_allclose(mine, 2.0)
+    assert mine.shape == (1, 2), mine.shape
+print("OK")
+""", nproc=3, timeout=240)
+    assert_all_ok(results)
+
+
 def test_ring_process_set_subgroup():
     results = run_workers(_RING_CHECK + """
 import numpy as np
