@@ -20,6 +20,10 @@ def main():
     parser.add_argument("--synthetic", action="store_true",
                         help="Use random data instead of downloading "
                              "MNIST.")
+    parser.add_argument("--run-eagerly", action="store_true",
+                        help="Per-op eager execution through the "
+                             "negotiated data plane (slower; for "
+                             "debugging).")
     args = parser.parse_args()
 
     hvd.init()
@@ -50,9 +54,13 @@ def main():
     # scaling), wrap the optimizer, broadcast initial state.
     opt = hvd.DistributedOptimizer(
         keras.optimizers.Adam(args.lr * hvd.size()))
+    # Graph mode: the whole train step (collectives included) runs as
+    # one traced tf.function via the in-graph collective path — ~3x
+    # faster per step than run_eagerly=True on this config. Pass
+    # --run-eagerly to debug with the negotiated eager data plane.
     model.compile(optimizer=opt,
                   loss="sparse_categorical_crossentropy",
-                  metrics=["accuracy"], run_eagerly=True)
+                  metrics=["accuracy"], run_eagerly=args.run_eagerly)
 
     callbacks = [
         hvd.callbacks.BroadcastGlobalVariablesCallback(0),

@@ -41,7 +41,9 @@ def train(num_epochs):
         opt.apply_gradients(zip(grads, model.trainable_variables))
         if first_batch:
             hvd.broadcast_variables(model.variables, root_rank=0)
-            hvd.broadcast_variables(opt.variables(), root_rank=0)
+            opt_vars = opt.variables() if callable(opt.variables) \
+                else opt.variables
+            hvd.broadcast_variables(opt_vars, root_rank=0)
         return loss_value
 
     for batch, (images, labels) in enumerate(

@@ -91,6 +91,10 @@ def load():
         lib.hvd_coord_cache_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
             ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_coord_drain_round_bytes.restype = ctypes.c_int
+        lib.hvd_coord_drain_round_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int]
         lib.hvd_coord_stall_report.restype = ctypes.c_int
         lib.hvd_coord_stall_report.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
@@ -142,28 +146,33 @@ class NativeCoordinatorServer:
                 daemon=True)
             self._poll_thread.start()
 
+    def drain_round_bytes(self, cap: int = 1024):
+        """All per-round fused-byte values committed since the last
+        drain (single consumer: the autotune poll thread, or a test)."""
+        buf = (ctypes.c_longlong * cap)()
+        vals = []
+        while True:
+            n = self._lib.hvd_coord_drain_round_bytes(
+                self._handle, buf, cap)
+            vals.extend(buf[:n])
+            if n < cap:
+                return vals
+
     def _poll_loop(self):
-        last_rounds, last_bytes = 0, 0
-        rounds = ctypes.c_longlong()
-        nbytes = ctypes.c_longlong()
+        # Drain the coordinator's per-round byte ring so the GP sees
+        # the true per-round distribution, not a window average
+        # (reference feeds the tuner per-cycle scores,
+        # parameter_manager.cc Update()).
         while not self._stop.wait(self.POLL_INTERVAL_S):
             if not self.param_manager.active:
                 return
-            self._lib.hvd_coord_stats(self._handle,
-                                      ctypes.byref(rounds),
-                                      ctypes.byref(nbytes))
-            dr = rounds.value - last_rounds
-            db = nbytes.value - last_bytes
-            last_rounds, last_bytes = rounds.value, nbytes.value
-            if dr <= 0:
-                continue
-            # Feed the window: dr negotiation rounds moved db bytes.
-            per_round = db // dr
-            for _ in range(dr):
-                self.param_manager.record_step(per_round)
-            self._lib.hvd_coord_set_fusion(
-                self._handle,
-                self.param_manager.fusion_threshold_bytes)
+            vals = self.drain_round_bytes()
+            for v in vals:
+                self.param_manager.record_step(v)
+            if vals:
+                self._lib.hvd_coord_set_fusion(
+                    self._handle,
+                    self.param_manager.fusion_threshold_bytes)
 
     def departure_counts(self):
         """(ever_connected, departed) rank-connection counters."""

@@ -30,6 +30,7 @@
 //   void  hvd_coord_stats(void*, long long* rounds, long long* bytes);
 //   void  hvd_coord_cache_stats(void*, long long* fast_rounds,
 //                               long long* full_rounds);
+//   int   hvd_coord_drain_round_bytes(void*, long long* out, int cap);
 //   int   hvd_coord_stall_report(void*, char* buf, int cap);
 //   void  hvd_coord_counts(void*, int* seen, int* departed);
 //   void  hvd_coord_stop(void*);
@@ -636,6 +637,26 @@ class Coordinator {
     *full = full_rounds_.load();
   }
 
+  // Drain up to `cap` per-round fused-byte values since the last call.
+  // Gives the autotuner the true per-round distribution (the GP models
+  // per-round throughput; a flat average would collapse its variance).
+  // Single consumer: only the host poll thread calls this. On overflow
+  // the oldest rounds are dropped.
+  int DrainRoundBytes(int64_t* out, int cap) {
+    int64_t w = round_w_.load(std::memory_order_acquire);
+    // Overflow clamp keeps half the ring as a safety margin: clamping
+    // to exactly w - kRoundRing would put the read cursor on the slot
+    // the writer fills next, and a commit racing the drain loop would
+    // hand the autotuner a torn int64.
+    if (w - round_r_ > kRoundRing / 2) round_r_ = w - kRoundRing / 2;
+    int n = 0;
+    while (round_r_ < w && n < cap) {
+      out[n++] = round_bytes_[round_r_ % kRoundRing];
+      ++round_r_;
+    }
+    return n;
+  }
+
   // Human-readable stall attribution, one line per stalled tensor.
   std::string StallReport() {
     std::string out;
@@ -1171,6 +1192,11 @@ class Coordinator {
     }
     rounds_.fetch_add(1);
     bytes_.fetch_add(nbytes);
+    // Per-round history for the autotuner (written under mu_; the
+    // host poll thread is the single reader).
+    round_bytes_[round_w_.load(std::memory_order_relaxed) % kRoundRing] =
+        nbytes;
+    round_w_.fetch_add(1, std::memory_order_release);
   }
 
   // Slice a fused response into per-tensor responses (mirrors
@@ -1325,6 +1351,10 @@ class Coordinator {
   std::atomic<int64_t> bytes_{0};
   std::atomic<int64_t> fast_rounds_{0};
   std::atomic<int64_t> full_rounds_{0};
+  static constexpr int kRoundRing = 8192;
+  std::vector<int64_t> round_bytes_ = std::vector<int64_t>(kRoundRing);
+  std::atomic<int64_t> round_w_{0};
+  int64_t round_r_ = 0;  // poll-thread-owned cursor
 };
 
 }  // namespace
@@ -1367,6 +1397,12 @@ void hvd_coord_cache_stats(void* h, long long* fast_rounds,
   static_cast<Coordinator*>(h)->cache_stats(&f, &n);
   *fast_rounds = f;
   *full_rounds = n;
+}
+
+int hvd_coord_drain_round_bytes(void* h, long long* out, int cap) {
+  static_assert(sizeof(long long) == sizeof(int64_t), "ABI");
+  return static_cast<Coordinator*>(h)->DrainRoundBytes(
+      reinterpret_cast<int64_t*>(out), cap);
 }
 
 int hvd_coord_stall_report(void* h, char* buf, int cap) {

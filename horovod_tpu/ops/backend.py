@@ -28,6 +28,16 @@ def _is_jax(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def even_row_counts(rows: int, gsize: int) -> List[int]:
+    """Dim-0 rows per group rank: base share each, first ranks absorb
+    the remainder.  The ONE uneven-split convention every backend
+    (XLA, ring) must agree on — ranks can mix paths via fallback
+    (reference: allgather displacement rule,
+    collective_operations.cc)."""
+    base, rem = divmod(rows, gsize)
+    return [base + (1 if r < rem else 0) for r in range(gsize)]
+
+
 def _scale(x, factor: float):
     if factor == 1.0:
         return x

@@ -21,7 +21,7 @@ from typing import List
 
 import numpy as np
 
-from .backend import Backend
+from .backend import Backend, even_row_counts
 
 logger = logging.getLogger("horovod_tpu.ring")
 
@@ -112,6 +112,9 @@ class RingBackend(Backend):
         # (hierarchical/flat) counters so observers see one view.
         self.stats = getattr(fallback, "stats", {})
         self.stats.setdefault("ring_allreduces", 0)
+        # Persistent per-dtype staging buffers (reference:
+        # fusion_buffer_manager.{h,cc}) — see _fused().
+        self._fusion_bufs = {}
         self._lib = None
         self._comm = None
         self._keys = []
@@ -249,6 +252,34 @@ class RingBackend(Backend):
             return x * x.dtype.type(factor)
         return (x * factor).astype(x.dtype)
 
+    @staticmethod
+    def _scale_inplace(buf: np.ndarray, factor: float):
+        if factor == 1.0:
+            return
+        if np.issubdtype(buf.dtype, np.inexact):
+            buf *= buf.dtype.type(factor)
+        else:
+            # Integer scaling truncates, matching _scale(); the float
+            # temp is the rare path (int Average / explicit factors).
+            np.copyto(buf, buf * factor, casting="unsafe")
+
+    def _fused(self, dtype: np.dtype, n: int) -> np.ndarray:
+        """Persistent staging buffer per work dtype, grown geometrically
+        — the CPU-ring analog of the reference's fusion buffer
+        (fusion_buffer_manager.{h,cc}).  Fresh 10s-of-MB numpy arrays
+        come from mmap and are returned to the OS on free, so staging
+        through temporaries costs a page-fault storm per collective
+        that exceeds the wire time; one hot reused buffer fixes that.
+        Only the background runtime thread dispatches collectives, so
+        a single buffer per dtype is safe."""
+        buf = self._fusion_bufs.get(dtype.str)
+        if buf is None or buf.size < n:
+            cap = max(n, 2 * (buf.size if buf is not None else 0),
+                      1 << 16)
+            buf = np.empty(cap, dtype)
+            self._fusion_bufs[dtype.str] = buf
+        return buf[:n]
+
     # -- allreduce -------------------------------------------------------
     def allreduce(self, arrays, reduce_op, prescale, postscale,
                   ps_ranks=()):
@@ -270,34 +301,36 @@ class RingBackend(Backend):
         if work_dt not in _DTYPES:
             return self.fallback.allreduce(arrays, reduce_op, prescale,
                                            postscale, ps_ranks)
-        flat = [self._scale(a, prescale).astype(work_dt).ravel()
-                for a in nps]
-        # One contiguous fused buffer per call: the in-place ring runs
-        # once over the whole batch (the reference's fusion-buffer
-        # memcpy in/out, collective_operations.h:96-125).
-        buf = np.ascontiguousarray(np.concatenate(flat)) if flat else \
-            np.zeros(0, work_dt)
-        if buf.size:
+        # One persistent fused buffer per call: a single copy in
+        # (converting dtype on the way), the in-place ring over the
+        # whole batch, scales applied in place, and one copy out per
+        # tensor into its own fresh output (the reference's
+        # fusion-buffer memcpy in/out, collective_operations.h:96-125).
+        total = sum(a.size for a in nps)
+        buf = self._fused(work_dt, total)
+        off = 0
+        for a in nps:
+            np.copyto(buf[off:off + a.size], a.reshape(-1),
+                      casting="unsafe")
+            off += a.size
+        self._scale_inplace(buf, prescale)
+        if total:
             rc = self._lib.hvd_ring_allreduce(
                 self._comm, buf.ctypes.data_as(ctypes.c_void_p),
-                buf.size, _DTYPES[work_dt], _OPS[reduce_op],
+                total, _DTYPES[work_dt], _OPS[reduce_op],
                 ranks_arr, nranks)
             if rc != 0:
                 raise RuntimeError(f"ring allreduce failed (rc={rc})")
         post = postscale
         if reduce_op == "Average":
             post = postscale / gsize
+        self._scale_inplace(buf, post)
         out, off = [], 0
         for a, odt, wj in zip(nps, orig_dtypes, was_jax):
-            piece = buf[off:off + a.size].reshape(a.shape)
+            piece = np.empty(a.shape, odt)
+            np.copyto(piece, buf[off:off + a.size].reshape(a.shape),
+                      casting="unsafe")
             off += a.size
-            piece = self._scale(piece, post)
-            if piece.dtype != odt:
-                piece = piece.astype(odt)
-            elif piece.base is not None:
-                # Own the memory: a view into the fused buffer would
-                # pin the whole batch for as long as any output lives.
-                piece = piece.copy()
             out.append(self._rewrap(piece, wj))
         return out
 
@@ -380,9 +413,8 @@ class RingBackend(Backend):
         if a.ndim == 0:
             a = a[None]
         if splits is None:
-            base, rem = divmod(a.shape[0], gsize)
-            splits = np.array([base + (1 if r < rem else 0)
-                               for r in range(gsize)], dtype=np.int64)
+            splits = np.array(even_row_counts(a.shape[0], gsize),
+                              dtype=np.int64)
         splits = np.ascontiguousarray(np.asarray(splits, np.int64))
         # Validate before anything reaches native code: a bad splits
         # vector must be a Python error, not an OOB read/write in C.
@@ -424,8 +456,12 @@ class RingBackend(Backend):
 
     # -- reducescatter ---------------------------------------------------
     def reducescatter(self, arrays, reduce_op, ps_ranks=()):
-        """One ring pass per fused batch — half the bandwidth of
-        allreduce-then-slice. Uneven dim-0 split convention matches the
+        """Fused reduce-scatter: all native-eligible tensors of a work
+        dtype ride ONE ring pass (k tensors would otherwise pay
+        k*(p-1) latency steps), packed rank-major so the per-rank chunk
+        of the fused buffer is the concatenation of every tensor's
+        chunk for that rank.  Half the bandwidth of
+        allreduce-then-slice; uneven dim-0 split convention matches the
         XLA backend (first ranks absorb the remainder)."""
         if reduce_op not in _OPS:
             return self.fallback.reducescatter(arrays, reduce_op,
@@ -433,41 +469,60 @@ class RingBackend(Backend):
         ps_ranks = tuple(ps_ranks)
         ranks_arr, nranks, gsize = self._group_args(ps_ranks)
         my_idx = self._my_index(ps_ranks)
-        out = []
-        for x in arrays:
-            wj = self._is_jax(x)
+        out: List = [None] * len(arrays)
+        groups = {}  # work dtype -> [(pos, np_array, was_jax)]
+        for i, x in enumerate(arrays):
             a = np.asarray(x)
-            orig_dt = a.dtype
             work_dt = np.dtype(_UPCAST.get(a.dtype, a.dtype))
             if work_dt not in _DTYPES or a.ndim == 0 or \
                     np.iscomplexobj(a):
-                res = self.fallback.reducescatter([x], reduce_op,
-                                                  ps_ranks)[0]
-                out.append(res)
+                out[i] = self.fallback.reducescatter([x], reduce_op,
+                                                     ps_ranks)[0]
                 continue
-            buf = np.ascontiguousarray(a, dtype=work_dt)
-            if buf is a or buf.base is not None:
-                buf = buf.copy()  # scratch is clobbered by the ring
-            row_elems = int(np.prod(a.shape[1:], initial=1))
-            base, rem = divmod(a.shape[0], gsize)
-            rows = [base + (1 if r < rem else 0) for r in range(gsize)]
-            counts = (ctypes.c_longlong * gsize)(
-                *[r * row_elems for r in rows])
-            res = np.empty((rows[my_idx],) + a.shape[1:], work_dt)
+            groups.setdefault(work_dt.str, []).append(
+                (i, a, self._is_jax(x)))
+        for dt_str, items in groups.items():
+            work_dt = np.dtype(dt_str)
+            rowcounts = [even_row_counts(a.shape[0], gsize)
+                         for _, a, _ in items]
+            rowelems = [int(np.prod(a.shape[1:], initial=1))
+                        for _, a, _ in items]
+            counts = [sum(rc[r] * re
+                          for rc, re in zip(rowcounts, rowelems))
+                      for r in range(gsize)]
+            buf = self._fused(work_dt, sum(counts))  # ring clobbers it
+            off = 0
+            row_off = [0] * len(items)
+            for r in range(gsize):
+                for j, (_, a, _) in enumerate(items):
+                    nel = rowcounts[j][r] * rowelems[j]
+                    src = a[row_off[j]:row_off[j] + rowcounts[j][r]]
+                    np.copyto(buf[off:off + nel], src.reshape(-1),
+                              casting="unsafe")
+                    row_off[j] += rowcounts[j][r]
+                    off += nel
+            counts_c = (ctypes.c_longlong * gsize)(*counts)
+            res = np.empty(counts[my_idx], work_dt)
             rc = self._lib.hvd_ring_reducescatter(
                 self._comm, buf.ctypes.data_as(ctypes.c_void_p),
-                counts, _DTYPES[work_dt], _OPS[reduce_op],
+                counts_c, _DTYPES[work_dt], _OPS[reduce_op],
                 res.ctypes.data_as(ctypes.c_void_p), ranks_arr, nranks)
             if rc != 0:
                 raise RuntimeError(
                     f"ring reducescatter failed (rc={rc})")
             if reduce_op == "Average":
-                res = self._scale(res, 1.0 / gsize)
-            if res.dtype != orig_dt:
-                res = res.astype(orig_dt)
-            out.append(self._rewrap(res, wj))
+                self._scale_inplace(res, 1.0 / gsize)
+            o = 0
+            for j, (i, a, wj) in enumerate(items):
+                myrows = rowcounts[j][my_idx]
+                nel = myrows * rowelems[j]
+                piece = np.empty((myrows,) + a.shape[1:], a.dtype)
+                np.copyto(piece, res[o:o + nel].reshape(piece.shape),
+                          casting="unsafe")
+                o += nel
+                out[i] = self._rewrap(piece, wj)
             self.stats["ring_reducescatters"] = \
-                self.stats.get("ring_reducescatters", 0) + 1
+                self.stats.get("ring_reducescatters", 0) + len(items)
         return out
 
     def barrier(self, ps_ranks=()):

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .backend import Backend
+from .backend import Backend, even_row_counts
 
 logger = logging.getLogger("horovod_tpu.xla_ops")
 
@@ -481,11 +481,8 @@ class XlaMeshBackend(Backend):
         arr = jnp.asarray(array) if was_jax else \
             jnp.asarray(np.asarray(array))
         if splits is None:
-            base = arr.shape[0] // gsize
-            rem = arr.shape[0] % gsize
-            splits = np.array(
-                [base + (1 if r < rem else 0) for r in range(gsize)],
-                dtype=np.int64)
+            splits = np.array(even_row_counts(arr.shape[0], gsize),
+                              dtype=np.int64)
         splits = np.asarray(splits, dtype=np.int64)
         # Exchange the split matrix first (small; the recv split vector
         # is part of the public API so it lives on the host anyway —
@@ -569,10 +566,8 @@ class XlaMeshBackend(Backend):
             arr = jnp.asarray(x) if was_jax else \
                 jnp.asarray(np.asarray(x))
             rows = arr.shape[0]
-            base, rem = divmod(rows, gsize)
-            chunk = base + (1 if rem else 0)
-            counts = tuple(base + (1 if r < rem else 0)
-                           for r in range(gsize))
+            counts = tuple(even_row_counts(rows, gsize))
+            chunk = max(counts) if counts else 0
             pack = self._rs_pack_fn(counts, chunk, tuple(arr.shape),
                                     str(arr.dtype))
             prepped.append(pack(arr))

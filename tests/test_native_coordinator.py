@@ -57,4 +57,32 @@ def test_native_lib_builds_and_binds():
         pytest.skip("no native toolchain")
     srv = NativeCoordinatorServer(2)
     assert srv.port > 0
+    assert srv.drain_round_bytes() == []   # no rounds committed yet
     srv.stop()
+
+
+def test_native_per_round_byte_history():
+    """The autotune feed must carry the TRUE per-round byte values, not
+    a window average (the GP models per-round throughput; VERDICT r2
+    flagged the old dr-rounds-at-db//dr-bytes replay as flattening the
+    distribution the tuner is supposed to learn from)."""
+    results = run_workers("""
+from horovod_tpu.common import basics
+# Distinct payload sizes in separate rounds (barrier forces a round
+# boundary between them).
+for i, n in enumerate((256, 65536)):
+    out = hvd.allreduce(np.ones(n, np.float32), op=hvd.Sum,
+                        name=f"rr.{i}")
+    assert out.shape == (n,)
+    hvd.barrier()
+if RANK == 0:
+    srv = basics._state().runtime.controller.server
+    vals = [v for v in srv.drain_round_bytes() if v > 0]
+    # Both payload sizes appear verbatim in the history.
+    assert 256 * 4 in vals, vals
+    assert 65536 * 4 in vals, vals
+print("HISTORY OK", RANK)
+""", nproc=2, extra_env={"HOROVOD_TPU_NATIVE": "1"})
+    assert_all_ok(results)
+    for _, out in results:
+        assert "HISTORY OK" in out

@@ -131,6 +131,18 @@ m = np.asarray(hvd.reducescatter(
 np.testing.assert_allclose(m.astype(np.float64),
                            (SIZE + 1) / 2.0, rtol=1e-3)
 
+# Fused multi-tensor reduce-scatter: both tensors ride one ring pass
+# (direct backend call — the runtime passes fused batches the same way)
+pre = state.backend.stats.get("ring_reducescatters", 0)
+outs = state.backend.reducescatter(
+    [np.ones((SIZE, 2), np.float32),
+     np.arange(2 * SIZE, dtype=np.float32).reshape(2 * SIZE, 1)],
+    "Sum")
+np.testing.assert_allclose(outs[0], SIZE * np.ones((1, 2)))
+np.testing.assert_allclose(
+    outs[1].ravel(), SIZE * np.arange(2 * RANK, 2 * RANK + 2))
+assert state.backend.stats["ring_reducescatters"] == pre + 2
+
 # A bad splits vector is a Python error before any native call
 # (not an OOB read/write in C).
 err = None
