@@ -43,6 +43,7 @@ from ..ops import (allgather, allgather_async, allreduce, allreduce_async,
                    synchronize)
 from ..ops.compression import Compression
 from .. import parallel
+from . import checkpoint
 
 __all__ = [
     "init", "shutdown", "rank", "size", "local_rank", "local_size",

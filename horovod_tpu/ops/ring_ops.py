@@ -291,7 +291,6 @@ class RingBackend(Backend):
                                            postscale, ps_ranks)
         ranks_arr, nranks, gsize = self._group_args(tuple(ps_ranks))
 
-        self.stats["ring_allreduces"] += 1
         was_jax = [self._is_jax(a) for a in arrays]
         nps = [np.asarray(a) for a in arrays]
         orig_dtypes = [a.dtype for a in nps]
@@ -301,6 +300,7 @@ class RingBackend(Backend):
         if work_dt not in _DTYPES:
             return self.fallback.allreduce(arrays, reduce_op, prescale,
                                            postscale, ps_ranks)
+        self.stats["ring_allreduces"] += 1
         # One persistent fused buffer per call: a single copy in
         # (converting dtype on the way), the in-place ring over the
         # whole batch, scales applied in place, and one copy out per
