@@ -6,6 +6,10 @@ look without hardware (dp * tp must cover the visible devices):
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     JAX_PLATFORMS=cpu python gpt_sharded_train.py --dp 4 --tp 2
+
+Pass --fsdp to ZeRO-3-shard parameters and optimizer state over the
+data axis (all-gather on use, reduce-scatter of grads) instead of
+replicating them.
 """
 
 import argparse
@@ -24,15 +28,20 @@ parser.add_argument("--tp", type=int, default=2)
 parser.add_argument("--batch-size", type=int, default=16)
 parser.add_argument("--seq-len", type=int, default=64)
 parser.add_argument("--steps", type=int, default=50)
+parser.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3-shard params/opt state over the data "
+                         "axis")
 args = parser.parse_args()
 
 cfg = gpt_tiny_config(max_position_embeddings=args.seq_len)
-mesh = build_mesh({"dp": args.dp, "tp": args.tp})
+data_axis = "fsdp" if args.fsdp else "dp"
+mesh = build_mesh({data_axis: args.dp, "tp": args.tp})
 # Parameters are annotated with the tensor-parallel rules inside
 # make_gpt_train_step; XLA inserts the collectives (the GSPMD recipe —
 # no hand-written allreduces).
 init_fn, step_fn, batch_sharding = make_gpt_train_step(
-    cfg, mesh, learning_rate=3e-3)
+    cfg, mesh, learning_rate=3e-3,
+    fsdp="fsdp" if args.fsdp else None)
 
 rng = np.random.RandomState(0)
 ids = jax.device_put(

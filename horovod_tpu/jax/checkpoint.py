@@ -30,20 +30,31 @@ from typing import Any, Optional
 
 logger = logging.getLogger("horovod_tpu.checkpoint")
 
-_managers = {}
+_managers = {}  # dir -> (manager, keep it was created with)
+_UNSET = object()
 
 
-def _manager(directory: str, keep: Optional[int] = None):
-    """One manager per directory; ``keep`` applies at creation time."""
+def _manager(directory: str, keep=_UNSET):
+    """One manager per directory.  Orbax fixes ``max_to_keep`` at
+    manager construction, so when a caller passes a different ``keep``
+    than the cached manager was built with (e.g. ``latest_step`` ran
+    before the first ``save(keep=N)``), the manager is rebuilt —
+    otherwise the retention bound would be silently dropped."""
     import orbax.checkpoint as ocp
 
     key = str(directory)
-    mgr = _managers.get(key)
-    if mgr is None:
-        mgr = ocp.CheckpointManager(
-            key, options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep, create=True))
-        _managers[key] = mgr
+    ent = _managers.get(key)
+    if ent is not None:
+        mgr, cur_keep = ent
+        if keep is _UNSET or keep == cur_keep:
+            return mgr
+        mgr.wait_until_finished()
+        mgr.close()
+    k = None if keep is _UNSET else keep
+    mgr = ocp.CheckpointManager(
+        key, options=ocp.CheckpointManagerOptions(
+            max_to_keep=k, create=True))
+    _managers[key] = (mgr, k)
     return mgr
 
 
@@ -92,7 +103,7 @@ def restore(directory: str, template: Any,
 def close() -> None:
     """Release cached managers (tests / repeated runs in one
     process)."""
-    for mgr in _managers.values():
+    for mgr, _keep in _managers.values():
         try:
             mgr.close()
         except Exception:

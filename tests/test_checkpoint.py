@@ -72,6 +72,24 @@ def test_keep_n_retention_and_latest(tmp_path):
     assert int(restored["step"]) == 4
 
 
+def test_keep_applies_after_latest_step_probe(tmp_path):
+    """The documented resume flow probes latest_step() BEFORE the first
+    save(keep=N); the retention bound must still apply (regression:
+    the manager cache pinned the first call's options, silently
+    dropping keep)."""
+    mesh = build_mesh({"dp": 8})
+    assert ckpt.latest_step(tmp_path) is None   # probe creates manager
+    for step in range(4):
+        ckpt.save(tmp_path, _sharded_state(mesh, seed=step), step=step,
+                  keep=2)
+    assert ckpt.latest_step(tmp_path) == 3
+    state = _sharded_state(mesh, seed=0)
+    template = jax.tree.map(jnp.zeros_like, state)
+    with pytest.raises(Exception):
+        ckpt.restore(tmp_path, template, step=0)   # pruned
+    assert int(ckpt.restore(tmp_path, template, step=3)["step"]) == 3
+
+
 def test_latest_step_empty_dir(tmp_path):
     assert ckpt.latest_step(tmp_path / "nothing_here") is None
     with pytest.raises(FileNotFoundError):

@@ -176,3 +176,29 @@ def test_all_success_stops_cleanly():
     assert driver.finished()
     assert driver.error_message is None
     assert set(driver.get_results().values()) == {0}
+
+
+def test_tpu_pod_discovery_env(monkeypatch):
+    """TPUPodDiscovery reads the slice worker list (env fallback path);
+    a preempted worker dropping out of the list shrinks the host map,
+    its return restores it — the TPU-native analog of a discovery
+    script whose output changes (reference: elastic_common.py
+    DISCOVERY_SCRIPT_TEMPLATE)."""
+    from horovod_tpu.runner.elastic.discovery import TPUPodDiscovery
+
+    disc = TPUPodDiscovery(slots=4)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "10.0.0.1,10.0.0.2")
+    assert disc.find_available_hosts_and_slots() == {
+        "10.0.0.1": 4, "10.0.0.2": 4}
+
+    # Preemption: worker 2 disappears from the metadata list.
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "10.0.0.1")
+    assert disc.find_available_hosts_and_slots() == {"10.0.0.1": 4}
+
+    # Off-TPU (no env, metadata unreachable): empty map, not an error.
+    # Stub the metadata fetch — the real one is a live HTTP call whose
+    # outcome (and latency) depends on the host environment.
+    from horovod_tpu.runner import tpu_metadata
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    monkeypatch.setattr(tpu_metadata, "_metadata_get", lambda *a: None)
+    assert disc.find_available_hosts_and_slots() == {}

@@ -191,3 +191,40 @@ def test_gpt_sharded_train_step_loss_decreases():
         params, opt_state, loss = step_fn(params, opt_state, ids)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_gpt_fsdp_train_step_shards_params_and_learns():
+    """FSDP (ZeRO-3) schedule: params and optimizer state shard over
+    the fsdp axis (not replicated), the batch rides the same axis, and
+    the loss still decreases — the reduce-scatter/all-gather schedule
+    the reference never exposed (SURVEY §2.3 FSDP row)."""
+    import numpy as np
+    from horovod_tpu.models import gpt_tiny_config
+    from horovod_tpu.parallel.mesh import build_mesh
+    from horovod_tpu.training import make_gpt_train_step
+
+    cfg = gpt_tiny_config()
+    mesh = build_mesh({"fsdp": 4, "tp": 2})
+    init_fn, step_fn, batch_sharding = make_gpt_train_step(
+        cfg, mesh, learning_rate=1e-2, fsdp="fsdp")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0,
+                             cfg.vocab_size)
+    ids = jax.device_put(ids, batch_sharding)
+    params, opt_state = init_fn(jax.random.PRNGKey(1), ids)
+
+    # At least one large kernel is genuinely fsdp-sharded, and its
+    # optimizer moment inherits that sharding.
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    sharded = [(jax.tree_util.keystr(p), l) for p, l in flat
+               if "fsdp" in str(l.sharding.spec)]
+    assert sharded, "no parameter sharded over the fsdp axis"
+    name0, leaf0 = sharded[0]
+    mu = jax.tree_util.tree_leaves_with_path(opt_state[0].mu)
+    mu_match = [l for p, l in mu if jax.tree_util.keystr(p) == name0]
+    assert mu_match and mu_match[0].sharding == leaf0.sharding
+
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step_fn(params, opt_state, ids)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
