@@ -96,7 +96,9 @@ class RayExecutor:
             coordinator.register(hostname, rank)
         env_by_rank = coordinator.finalize_registration()
 
-        self._server = RendezvousServer()
+        from ..runner import job_secret
+        self._secret = job_secret.make_secret_key()
+        self._server = RendezvousServer(secret=self._secret)
         rendezvous_port = self._server.start()
         self._server.init({})
         driver_ip = ray.util.get_node_ip_address() \
@@ -108,6 +110,7 @@ class RayExecutor:
             "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
             "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
             "HOROVOD_CONTROLLER": "tcp",
+            job_secret.ENV: self._secret,
             "HOROVOD_TPU_COORDINATOR": f"{rank0_host}:{coord_port}",
             "HOROVOD_CONTROLLER_ADDR": f"{rank0_host}:{ctrl_port}",
         }

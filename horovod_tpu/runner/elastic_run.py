@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 from . import safe_shell_exec
 from .hosts import SlotInfo
+from . import job_secret
 from .http_server import RendezvousServer, local_addresses
 from .elastic.discovery import HostDiscovery
 from .elastic.driver import ElasticDriver
@@ -43,8 +44,9 @@ def launch_elastic(command: List[str],
                    ) -> Dict[str, int]:
     """Run ``command`` elastically; returns {host:slot: exit_code}."""
     requested = int(os.environ.get(PREPROVISIONED_PORT_ENV, 0))
+    secret = job_secret.for_job(env)
     server = RendezvousServer(verbose, handler_cls=ElasticRendezvousHandler,
-                              port=requested)
+                              port=requested, secret=secret)
     rendezvous_port = server.start()
     server.init({})
 
@@ -77,9 +79,17 @@ def launch_elastic(command: List[str],
                            for k, v in worker_env.items())
         fwd = " ".join(f"{k}={shlex.quote(v)}"
                        for k, v in base_env.items()
-                       if _exportable(k, v) and k not in worker_env)
+                       if _exportable(k, v) and k not in worker_env and
+                       k != job_secret.ENV)
         cmd = f"{assigns} {fwd} {run_command}"
-        if not local:
+        exec_env = None
+        if local:
+            # The HMAC key rides the subprocess env, never a local
+            # command line (world-readable via /proc/*/cmdline).
+            exec_env = dict(os.environ)
+            exec_env[job_secret.ENV] = secret
+        else:
+            cmd = f"{job_secret.ENV}={shlex.quote(secret)} {cmd}"
             cmd = _ssh_command(slot.hostname, cmd, ssh_port,
                                ssh_identity_file)
         stdout = stderr = None
@@ -94,7 +104,7 @@ def launch_elastic(command: List[str],
                         slot.local_rank)
         try:
             return safe_shell_exec.execute(
-                cmd, stdout=stdout, stderr=stderr,
+                cmd, env=exec_env, stdout=stdout, stderr=stderr,
                 index=slot.rank)
         finally:
             for f in (stdout, stderr):

@@ -23,11 +23,14 @@ from typing import Dict, Optional, Tuple
 from urllib.error import HTTPError
 from urllib.request import Request as UrlRequest, urlopen
 
+from . import job_secret
+
 logger = logging.getLogger("horovod_tpu.rendezvous")
 
 OK = 200
 NOT_FOUND = 404
 BAD_REQUEST = 400
+FORBIDDEN = 403
 
 
 class KVStore:
@@ -73,7 +76,28 @@ class KVStoreHandler(BaseHTTPRequestHandler):
     def handle_get_special(self, scope: str, key: str) -> Optional[bytes]:
         return None
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        """HMAC check against the server's job secret (reference:
+        network.py BasicService message verification).  No secret on
+        the server = open (direct/unit-test use); launchers always set
+        one."""
+        secret = getattr(self.server, "secret", None)
+        if not secret:
+            return True
+        if job_secret.verify(secret,
+                             self.headers.get(job_secret.HEADER),
+                             self.command, self.path, body):
+            return True
+        self.send_response(FORBIDDEN)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        logger.warning("rejected unsigned %s %s from %s", self.command,
+                       self.path, self.client_address[0])
+        return False
+
     def do_GET(self):
+        if not self._authorized():
+            return
         scope, key = self._split()
         special = self.handle_get_special(scope, key)
         value = special if special is not None \
@@ -89,15 +113,19 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_PUT(self):
-        scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if not self._authorized(value):
+            return
+        scope, key = self._split()
         self.server.kvstore.put(scope, key, value)
         self.send_response(OK)
         self.send_header("Content-Length", "0")
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         scope, _ = self._split()
         self.server.kvstore.finalize(scope)
         self.send_response(OK)
@@ -112,10 +140,16 @@ class RendezvousServer:
     """Threaded HTTP KV server; ``start()`` returns the bound port."""
 
     def __init__(self, verbose: int = 0,
-                 handler_cls=KVStoreHandler, port: int = 0):
+                 handler_cls=KVStoreHandler, port: int = 0,
+                 secret: Optional[str] = None):
         self._verbose = verbose
         self._handler_cls = handler_cls
         self._requested_port = port
+        # Per-job HMAC key (explicit beats env so two jobs launched
+        # from one driver process never share a key); None + no env =
+        # open server (direct construction in tests).
+        self._secret = secret if secret is not None \
+            else job_secret.current()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -128,6 +162,7 @@ class RendezvousServer:
         self._httpd = ThreadingHTTPServer(
             ("0.0.0.0", self._requested_port), cls)
         self._httpd.kvstore = KVStore()
+        self._httpd.secret = self._secret
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
@@ -153,22 +188,35 @@ class RendezvousServer:
 
 
 class RendezvousClient:
-    """Tiny blocking HTTP client for the KV store."""
+    """Tiny blocking HTTP client for the KV store.  Signs every
+    request with the job secret (``HOROVOD_SECRET_KEY``, forwarded by
+    the launcher env contract) when one is present."""
 
-    def __init__(self, addr: str, port: int, timeout: float = 30.0):
+    def __init__(self, addr: str, port: int, timeout: float = 30.0,
+                 secret: Optional[str] = None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
+        self._secret = secret if secret is not None \
+            else job_secret.current()
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None) -> UrlRequest:
+        req = UrlRequest(self._base + path, data=body, method=method)
+        if self._secret:
+            req.add_header(job_secret.HEADER,
+                           job_secret.sign(self._secret, method, path,
+                                           body or b""))
+        return req
 
     def put(self, scope: str, key: str, value: bytes):
-        req = UrlRequest(f"{self._base}/{scope}/{key}", data=value,
-                         method="PUT")
+        req = self._request("PUT", f"/{scope}/{key}", value)
         with urlopen(req, timeout=self._timeout):
             pass
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         try:
-            with urlopen(f"{self._base}/{scope}/{key}",
-                         timeout=self._timeout) as r:
+            req = self._request("GET", f"/{scope}/{key}")
+            with urlopen(req, timeout=self._timeout) as r:
                 return r.read()
         except HTTPError as e:
             if e.code == NOT_FOUND:
@@ -187,7 +235,7 @@ class RendezvousClient:
         raise TimeoutError(f"rendezvous key {scope}/{key} never appeared")
 
     def delete(self, scope: str):
-        req = UrlRequest(f"{self._base}/{scope}/", method="DELETE")
+        req = self._request("DELETE", f"/{scope}/")
         with urlopen(req, timeout=self._timeout):
             pass
 

@@ -1,0 +1,61 @@
+"""Per-job shared secret + HMAC signing for control-plane RPC.
+
+The reference HMAC-signs every driver/task service message with a
+random per-job key so stray or malicious connections to the service
+ports can't inject commands or read rendezvous state (reference:
+runner/common/util/secret.py make_secret_key, network.py BasicService
+_verify_message).  Here the same contract protects the rendezvous
+HTTP KV store: launchers generate the key once, forward it through the
+worker env (``HOROVOD_SECRET_KEY``), and both ends sign
+``(method, path, body)`` with HMAC-SHA256.
+
+A server started without a key (e.g. directly in a unit test) accepts
+unsigned requests — the launcher paths always set one.
+"""
+
+import base64
+import hashlib
+import hmac
+import os
+from typing import Optional
+
+ENV = "HOROVOD_SECRET_KEY"
+HEADER = "X-Horovod-Sig"
+
+
+def make_secret_key() -> str:
+    """A fresh url-safe 256-bit key."""
+    return base64.urlsafe_b64encode(os.urandom(32)).decode()
+
+
+def current() -> Optional[str]:
+    return os.environ.get(ENV) or None
+
+
+def for_job(env: Optional[dict] = None) -> str:
+    """The key for ONE job launch: honor a caller/worker-provided key
+    (``env`` dict or process env), else mint a fresh one.  Launchers
+    hold the result in a local and thread it explicitly to their
+    server and worker envs — deliberately NOT exported to os.environ,
+    so two jobs launched from one driver process never share a key."""
+    if env and env.get(ENV):
+        return env[ENV]
+    return current() or make_secret_key()
+
+
+def sign(secret: str, method: str, path: str, body: bytes) -> str:
+    mac = hmac.new(secret.encode(), digestmod=hashlib.sha256)
+    for part in (method.encode(), path.encode(), body):
+        # Length-prefix each field so ("PU","T/x") can't collide with
+        # ("PUT","/x").
+        mac.update(len(part).to_bytes(8, "big"))
+        mac.update(part)
+    return mac.hexdigest()
+
+
+def verify(secret: str, signature: Optional[str], method: str,
+           path: str, body: bytes) -> bool:
+    if not signature:
+        return False
+    return hmac.compare_digest(sign(secret, method, path, body),
+                               signature)

@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional
 from . import safe_shell_exec
 from .hosts import SlotInfo, get_host_assignments, parse_hosts, \
     slot_env_vars
+from . import job_secret
 from .http_server import RendezvousServer, find_ports, local_addresses
 
 logger = logging.getLogger("horovod_tpu.run")
@@ -77,10 +78,15 @@ def slot_command(run_command: str, slot: SlotInfo, env: Dict[str, str],
     slot_env = dict(common_env)
     slot_env.update(slot_env_vars(slot))
     slot_env["PYTHONUNBUFFERED"] = "1"
+    slot_env.pop(job_secret.ENV, None)
     assigns = " ".join(f"{k}={shlex.quote(str(v))}"
                        for k, v in slot_env.items())
+    # The HMAC key never rides the command line (world-readable via
+    # /proc/*/cmdline locally); the caller transports it via the
+    # subprocess env or the ssh channel.
     fwd = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
-                   if _exportable(k, v) and k not in slot_env)
+                   if _exportable(k, v) and k not in slot_env and
+                   k != job_secret.ENV)
     return f"{assigns} {fwd} {run_command}"
 
 
@@ -125,7 +131,10 @@ def launch_static(command: List[str],
     rank0_host = slots[0].hostname
 
     requested = int(os.environ.get(PREPROVISIONED_PORT_ENV, 0))
-    server = RendezvousServer(verbose, port=requested)
+    # Per-job HMAC key: the server requires it on every request, the
+    # env contract hands it to workers (reference secret.py/network.py).
+    secret = job_secret.for_job(env)
+    server = RendezvousServer(verbose, port=requested, secret=secret)
     rendezvous_port = server.start()
     server.init({})
 
@@ -187,7 +196,17 @@ def launch_static(command: List[str],
     def _run_slot(slot: SlotInfo):
         cmd = slot_command(run_command, slot, env or dict(os.environ),
                            common_env)
-        if not is_local(slot.hostname):
+        exec_env = None
+        if is_local(slot.hostname):
+            # Local: the key rides the subprocess env, never the
+            # command line.
+            exec_env = dict(os.environ)
+            exec_env[job_secret.ENV] = secret
+        else:
+            # Remote: inline on the far side of the ssh channel (the
+            # reference transports its service key on the remote argv
+            # the same way, driver_service.py launch params).
+            cmd = f"{job_secret.ENV}={shlex.quote(secret)} {cmd}"
             cmd = _ssh_command(slot.hostname, cmd, ssh_port,
                                ssh_identity_file)
         stdout = stderr = None
@@ -201,8 +220,8 @@ def launch_static(command: List[str],
                         slot.hostname)
         try:
             code = safe_shell_exec.execute(
-                cmd, stdout=stdout, stderr=stderr, index=slot.rank,
-                events=events)
+                cmd, env=exec_env, stdout=stdout, stderr=stderr,
+                index=slot.rank, events=events)
         finally:
             for f in (stdout, stderr):
                 if f:
@@ -259,16 +278,18 @@ def run_func(func: Callable, hosts: str, np: int,
     host_infos = parse_hosts(hosts)
     slots = get_host_assignments(host_infos, np, np)
 
-    server = RendezvousServer(verbose)
+    secret = job_secret.for_job(env)
+    server = RendezvousServer(verbose, secret=secret)
     rendezvous_port = server.start()
     server.init({})
     driver_ip = "127.0.0.1" if all(is_local(s.hostname) for s in slots) \
         else local_addresses()[0]
-    client = RendezvousClient(driver_ip, rendezvous_port)
+    client = RendezvousClient(driver_ip, rendezvous_port, secret=secret)
     client.put(_FUNC_SCOPE, "func", cloudpickle.dumps(func))
 
     command = [sys.executable, "-m", "horovod_tpu.runner.tpu_run"]
     worker_env = dict(env or os.environ)
+    worker_env[job_secret.ENV] = secret
     worker_env.setdefault("PYTHONPATH", os.pathsep.join(sys.path))
     try:
         # The static launcher runs its own rendezvous server for worker

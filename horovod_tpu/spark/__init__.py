@@ -45,7 +45,9 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     if num_proc is None:
         num_proc = max(int(sc.defaultParallelism), 1)
 
-    server = RendezvousServer(verbose)
+    from ..runner import job_secret
+    secret = job_secret.make_secret_key()
+    server = RendezvousServer(verbose, secret=secret)
     rendezvous_port = server.start()
     server.init({})
     driver_ip = local_addresses()[0]
@@ -74,6 +76,9 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
             "HOROVOD_GLOO_RENDEZVOUS_ADDR": driver_ip,
             "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
             "HOROVOD_CONTROLLER": "tcp",
+            # Closure-captured: spark executors don't inherit the
+            # driver env, so the HMAC key rides the pickled task fn.
+            "HOROVOD_SECRET_KEY": secret,
         })
         # Rank 0 announces coordinator/controller endpoints through the
         # barrier so all tasks agree.
@@ -87,6 +92,8 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
         coord, ctrl = all_endpoints[0].split(",")
         env["HOROVOD_TPU_COORDINATOR"] = coord
         env["HOROVOD_CONTROLLER_ADDR"] = ctrl
+        if extra_env:
+            env.update(extra_env)
         os.environ.update(env)
 
         f, a, kw = cloudpickle.loads(payload)

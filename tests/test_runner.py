@@ -186,3 +186,71 @@ def test_check_build_flag(capsys):
     assert "[X] JAX" in out
     assert "Available Controllers" in out
     assert "RING" in out
+
+
+def test_rendezvous_hmac_auth(monkeypatch):
+    """With a job secret in force, the KV server accepts only
+    HMAC-signed requests (reference: runner/common/util/secret.py +
+    network.py message verification): a signing client round-trips,
+    unsigned or wrong-key requests get 403 and mutate nothing."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+    from horovod_tpu.runner import job_secret
+
+    key = job_secret.make_secret_key()
+    monkeypatch.setenv(job_secret.ENV, key)
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        client = RendezvousClient("127.0.0.1", port)   # signs from env
+        client.put("s", "k", b"v")
+        assert client.get("s", "k") == b"v"
+
+        # Unsigned PUT: rejected, store untouched.
+        with pytest.raises(HTTPError) as e:
+            urlopen(Request(f"http://127.0.0.1:{port}/s/evil",
+                            data=b"x", method="PUT"), timeout=5)
+        assert e.value.code == 403
+        assert server.kvstore.get("s", "evil") is None
+
+        # Unsigned GET: no data leak.
+        with pytest.raises(HTTPError) as e:
+            urlopen(f"http://127.0.0.1:{port}/s/k", timeout=5)
+        assert e.value.code == 403
+
+        # Wrong key: rejected.
+        bad = RendezvousClient("127.0.0.1", port,
+                               secret=job_secret.make_secret_key())
+        with pytest.raises(HTTPError) as e:
+            bad.put("s", "k2", b"x")
+        assert e.value.code == 403
+        assert server.kvstore.get("s", "k2") is None
+    finally:
+        server.stop()
+
+
+def test_rendezvous_open_without_secret(monkeypatch):
+    """No job secret (direct construction, e.g. unit tests) keeps the
+    server open to unsigned requests."""
+    from horovod_tpu.runner import job_secret
+    monkeypatch.delenv(job_secret.ENV, raising=False)
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        client = RendezvousClient("127.0.0.1", port, secret="")
+        client.put("s", "k", b"v")
+        assert client.get("s", "k") == b"v"
+    finally:
+        server.stop()
+
+
+def test_job_secret_isolation(monkeypatch):
+    """Each launch mints its own key unless the caller supplies one —
+    two jobs from one driver process must not share secrets."""
+    from horovod_tpu.runner import job_secret
+    monkeypatch.delenv(job_secret.ENV, raising=False)
+    a, b = job_secret.for_job(None), job_secret.for_job(None)
+    assert a != b
+    assert job_secret.for_job({job_secret.ENV: "pinned"}) == "pinned"
+    monkeypatch.setenv(job_secret.ENV, "from-env")
+    assert job_secret.for_job(None) == "from-env"
