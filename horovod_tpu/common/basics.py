@@ -133,10 +133,24 @@ def _maybe_init_jax_distributed(info: RankInfo):
             os.environ.get("HOROVOD_TPU_FORCE_CPU"):
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.config.update("jax_platforms", "cpu")
+    if _state().knobs.elastic:
+        # A peer hard-dying must surface as HorovodInternalError and
+        # unwind to the elastic retry loop — without this flag the
+        # coordination service's error polling TERMINATES survivor
+        # processes outright (client.h fatal on peer heartbeat
+        # timeout), so recovery never runs.
+        try:
+            jax.config.update("jax_enable_recoverability", True)
+        except AttributeError:
+            pass  # older jax: survivors may die with the peer
+    heartbeat = os.environ.get("HOROVOD_JAX_HEARTBEAT_TIMEOUT")
+    kwargs = {}
+    if heartbeat:
+        kwargs["heartbeat_timeout_seconds"] = int(heartbeat)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=info.size,
-        process_id=info.rank)
+        process_id=info.rank, **kwargs)
     return True
 
 

@@ -22,8 +22,8 @@ from .http_server import RendezvousServer, local_addresses
 from .elastic.discovery import HostDiscovery
 from .elastic.driver import ElasticDriver
 from .elastic.rendezvous import ElasticRendezvousHandler
-from .tpu_run import PREPROVISIONED_PORT_ENV, _exportable, _ssh_command, \
-    is_local
+from .tpu_run import (PREPROVISIONED_PORT_ENV, _exportable,
+                      _ssh_command, is_local, secret_transport)
 
 logger = logging.getLogger("horovod_tpu.elastic")
 
@@ -82,14 +82,8 @@ def launch_elastic(command: List[str],
                        if _exportable(k, v) and k not in worker_env and
                        k != job_secret.ENV)
         cmd = f"{assigns} {fwd} {run_command}"
-        exec_env = None
-        if local:
-            # The HMAC key rides the subprocess env, never a local
-            # command line (world-readable via /proc/*/cmdline).
-            exec_env = dict(os.environ)
-            exec_env[job_secret.ENV] = secret
-        else:
-            cmd = f"{job_secret.ENV}={shlex.quote(secret)} {cmd}"
+        cmd, exec_env, stdin_data = secret_transport(cmd, secret, local)
+        if not local:
             cmd = _ssh_command(slot.hostname, cmd, ssh_port,
                                ssh_identity_file)
         stdout = stderr = None
@@ -104,8 +98,8 @@ def launch_elastic(command: List[str],
                         slot.local_rank)
         try:
             return safe_shell_exec.execute(
-                cmd, env=exec_env, stdout=stdout, stderr=stderr,
-                index=slot.rank)
+                cmd, env=exec_env, stdin_data=stdin_data,
+                stdout=stdout, stderr=stderr, index=slot.rank)
         finally:
             for f in (stdout, stderr):
                 if f:

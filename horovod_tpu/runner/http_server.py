@@ -86,7 +86,8 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             return True
         if job_secret.verify(secret,
                              self.headers.get(job_secret.HEADER),
-                             self.command, self.path, body):
+                             self.command, self.path, body,
+                             self.headers.get(job_secret.TS_HEADER)):
             return True
         self.send_response(FORBIDDEN)
         self.send_header("Content-Length", "0")
@@ -203,9 +204,12 @@ class RendezvousClient:
                  body: Optional[bytes] = None) -> UrlRequest:
         req = UrlRequest(self._base + path, data=body, method=method)
         if self._secret:
+            import time
+            ts = repr(time.time())
+            req.add_header(job_secret.TS_HEADER, ts)
             req.add_header(job_secret.HEADER,
                            job_secret.sign(self._secret, method, path,
-                                           body or b""))
+                                           body or b"", ts))
         return req
 
     def put(self, scope: str, key: str, value: bytes):

@@ -21,6 +21,7 @@ from typing import Optional
 
 ENV = "HOROVOD_SECRET_KEY"
 HEADER = "X-Horovod-Sig"
+TS_HEADER = "X-Horovod-Ts"
 
 
 def make_secret_key() -> str:
@@ -43,9 +44,17 @@ def for_job(env: Optional[dict] = None) -> str:
     return current() or make_secret_key()
 
 
-def sign(secret: str, method: str, path: str, body: bytes) -> str:
+# Bound on |server clock - client timestamp|: replayed requests die
+# after this window (full anti-replay would need per-request nonces;
+# the window is the standard cheap mitigation for a LAN control plane).
+MAX_SKEW_S = 900.0
+
+
+def sign(secret: str, method: str, path: str, body: bytes,
+         timestamp: str) -> str:
     mac = hmac.new(secret.encode(), digestmod=hashlib.sha256)
-    for part in (method.encode(), path.encode(), body):
+    for part in (method.encode(), path.encode(), body,
+                 timestamp.encode()):
         # Length-prefix each field so ("PU","T/x") can't collide with
         # ("PUT","/x").
         mac.update(len(part).to_bytes(8, "big"))
@@ -54,8 +63,23 @@ def sign(secret: str, method: str, path: str, body: bytes) -> str:
 
 
 def verify(secret: str, signature: Optional[str], method: str,
-           path: str, body: bytes) -> bool:
-    if not signature:
+           path: str, body: bytes, timestamp: Optional[str],
+           max_skew_s: float = MAX_SKEW_S) -> bool:
+    import time
+
+    if not signature or not timestamp:
         return False
-    return hmac.compare_digest(sign(secret, method, path, body),
-                               signature)
+    try:
+        ts = float(timestamp)
+    except ValueError:
+        return False
+    if abs(time.time() - ts) > max_skew_s:
+        return False
+    try:
+        expected = sign(secret, method, path, body, timestamp)
+        return hmac.compare_digest(expected.encode(),
+                                   signature.encode())
+    except (UnicodeEncodeError, TypeError):
+        # Attacker-controlled header bytes must yield False, not an
+        # unhandled handler exception.
+        return False

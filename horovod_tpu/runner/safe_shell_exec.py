@@ -72,13 +72,23 @@ def execute(command: str,
             stderr: Optional[IO] = None,
             index: Optional[int] = None,
             events: Optional[List[threading.Event]] = None,
-            prefix_output_with_timestamp: bool = False) -> int:
+            prefix_output_with_timestamp: bool = False,
+            stdin_data: Optional[bytes] = None) -> int:
     """Run ``command`` through a shell in a new session; stream output;
-    kill the whole tree if any event fires.  Returns the exit code."""
+    kill the whole tree if any event fires.  Returns the exit code.
+    ``stdin_data`` is written to the child's stdin and the pipe closed
+    (used to hand secrets to remote shells without touching argv)."""
     proc = subprocess.Popen(
         command, shell=True, env=env,
+        stdin=subprocess.PIPE if stdin_data is not None else None,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         start_new_session=True)
+    if stdin_data is not None:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.close()
+        except BrokenPipeError:
+            pass
 
     prefix = ""
     if index is not None:

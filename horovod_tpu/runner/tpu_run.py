@@ -90,6 +90,23 @@ def slot_command(run_command: str, slot: SlotInfo, env: Dict[str, str],
     return f"{assigns} {fwd} {run_command}"
 
 
+def secret_transport(cmd: str, secret: str, local: bool):
+    """(command, exec_env, stdin_data) that keeps the job key off every
+    argv: a local worker gets it via the subprocess environment; a
+    remote worker's far-side shell reads it from the ssh channel's
+    stdin (``read`` consumes one line before exec'ing the real
+    command), so neither the driver's ssh argv nor the remote argv
+    ever carries the key (/proc/*/cmdline is world-readable on both
+    ends)."""
+    if local:
+        exec_env = dict(os.environ)
+        exec_env[job_secret.ENV] = secret
+        return cmd, exec_env, None
+    wrapped = (f"IFS= read -r {job_secret.ENV}; "
+               f"export {job_secret.ENV}; {cmd}")
+    return wrapped, None, (secret + "\n").encode()
+
+
 class WorkerResults:
     """Collects per-slot exit codes; any non-zero marks failure."""
 
@@ -196,17 +213,9 @@ def launch_static(command: List[str],
     def _run_slot(slot: SlotInfo):
         cmd = slot_command(run_command, slot, env or dict(os.environ),
                            common_env)
-        exec_env = None
-        if is_local(slot.hostname):
-            # Local: the key rides the subprocess env, never the
-            # command line.
-            exec_env = dict(os.environ)
-            exec_env[job_secret.ENV] = secret
-        else:
-            # Remote: inline on the far side of the ssh channel (the
-            # reference transports its service key on the remote argv
-            # the same way, driver_service.py launch params).
-            cmd = f"{job_secret.ENV}={shlex.quote(secret)} {cmd}"
+        local = is_local(slot.hostname)
+        cmd, exec_env, stdin_data = secret_transport(cmd, secret, local)
+        if not local:
             cmd = _ssh_command(slot.hostname, cmd, ssh_port,
                                ssh_identity_file)
         stdout = stderr = None
@@ -220,8 +229,9 @@ def launch_static(command: List[str],
                         slot.hostname)
         try:
             code = safe_shell_exec.execute(
-                cmd, env=exec_env, stdout=stdout, stderr=stderr,
-                index=slot.rank, events=events)
+                cmd, env=exec_env, stdin_data=stdin_data,
+                stdout=stdout, stderr=stderr, index=slot.rank,
+                events=events)
         finally:
             for f in (stdout, stderr):
                 if f:

@@ -125,3 +125,119 @@ def test_elastic_world_grows(tmp_path):
     assert set(result["codes"].values()) == {0}
     logs = _scan_logs(outdir)
     assert len(re.findall(r"DONE rank=\d", logs)) == 3
+
+
+KILLABLE_WORKER = """
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+import horovod_tpu.jax as hj
+from horovod_tpu.jax.elastic import JaxState, run
+
+hvd.init()
+state = JaxState(epoch=0)
+STOP = os.environ["TEST_STOP_FILE"]
+DOOMED = os.environ["HOROVOD_HOSTNAME"] == os.environ["TEST_DOOMED_HOST"]
+
+@run
+def train(state):
+    while not os.path.exists(STOP):
+        if DOOMED and state.epoch >= 3:
+            print("DYING", flush=True)
+            os._exit(1)   # hard death mid-run, no cleanup
+        val = np.asarray(hj.allreduce(
+            np.ones(4, np.float32), op=hvd.Sum,
+            name=f"t{state.epoch}"))
+        assert val[0] == hvd.size(), (val, hvd.size())
+        print(f"EPOCH {state.epoch} rank={hvd.rank()} "
+              f"size={hvd.size()}", flush=True)
+        state.epoch += 1
+        state.commit()
+        time.sleep(0.05)
+    return state.epoch
+
+train(state)
+print(f"DONE rank={hvd.rank()} epoch={state.epoch} "
+      f"size={hvd.size()}", flush=True)
+"""
+
+
+def test_elastic_worker_death_shrinks_world(tmp_path):
+    """A worker hard-dies (os._exit, no cleanup) mid-run: the driver
+    records the failure, blacklists that host, survivors unwind via
+    HorovodInternalError, restore committed state, and continue at the
+    smaller world size (reference: exit_schedule scenarios,
+    test/integration/elastic_common.py; failure path SURVEY §5).
+    Two distinct host strings (localhost / 127.0.0.1) both resolve
+    locally, so blacklisting the doomed 'host' spares the survivor."""
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner.elastic_run import launch_elastic
+
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:1\n127.0.0.1:1\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+    stop_file = tmp_path / "stop"
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(KILLABLE_WORKER)
+    outdir = tmp_path / "out"
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    result = {}
+
+    def run_launcher():
+        try:
+            result["codes"] = launch_elastic(
+                [sys.executable, str(worker_py)],
+                discovery=HostDiscoveryScript(str(script), 1),
+                np=2, min_np=1, max_np=2,
+                elastic_timeout=60,
+                output_filename=str(outdir),
+                env=env,
+                extra_worker_env={
+                    "HOROVOD_TPU_FORCE_CPU": "1",
+                    "TEST_STOP_FILE": str(stop_file),
+                    "TEST_DOOMED_HOST": "127.0.0.1",
+                    "HOROVOD_START_TIMEOUT": "60",
+                })
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=run_launcher, daemon=True)
+    t.start()
+
+    def wait_for(pattern, timeout=120):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if re.search(pattern, _scan_logs(outdir)):
+                return
+            if not t.is_alive():
+                raise AssertionError(
+                    f"launcher exited early: {result}\n"
+                    f"logs:\n{_scan_logs(outdir)[-3000:]}")
+            time.sleep(0.5)
+        raise AssertionError(
+            f"pattern {pattern!r} never appeared; logs:\n"
+            f"{_scan_logs(outdir)[-3000:]}")
+
+    # Phase 1: both workers train at size 2; the doomed one dies.
+    wait_for(r"EPOCH \d+ rank=\d size=2")
+    wait_for(r"DYING")
+    # Phase 2: the survivor re-forms at size 1, resuming from a
+    # committed epoch >= 3 (state survived the membership change).
+    wait_for(r"EPOCH [3-9]\d* rank=0 size=1")
+    # Phase 3: stop; survivor exits cleanly.
+    stop_file.write_text("")
+    t.join(timeout=120)
+    assert not t.is_alive(), "launcher did not finish"
+    assert "error" not in result, result.get("error")
+    logs = _scan_logs(outdir)
+    m = re.search(r"DONE rank=0 epoch=(\d+) size=1", logs)
+    assert m and int(m.group(1)) >= 3, logs[-2000:]
+    # The dead slot's non-zero code is recorded, not fatal.
+    assert any(c != 0 for c in result["codes"].values()), result

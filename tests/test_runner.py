@@ -225,6 +225,27 @@ def test_rendezvous_hmac_auth(monkeypatch):
             bad.put("s", "k2", b"x")
         assert e.value.code == 403
         assert server.kvstore.get("s", "k2") is None
+
+        # Replay protection: a correctly-signed request with a stale
+        # timestamp is rejected.
+        import time
+        ts = repr(time.time() - 2 * job_secret.MAX_SKEW_S)
+        req = Request(f"http://127.0.0.1:{port}/s/k", method="GET")
+        req.add_header(job_secret.TS_HEADER, ts)
+        req.add_header(job_secret.HEADER,
+                       job_secret.sign(key, "GET", "/s/k", b"", ts))
+        with pytest.raises(HTTPError) as e:
+            urlopen(req, timeout=5)
+        assert e.value.code == 403
+
+        # Malformed (non-ASCII) signature: clean 403, not a handler
+        # traceback.
+        req = Request(f"http://127.0.0.1:{port}/s/k", method="GET")
+        req.add_header(job_secret.TS_HEADER, repr(time.time()))
+        req.add_header(job_secret.HEADER, "café")
+        with pytest.raises(HTTPError) as e:
+            urlopen(req, timeout=5)
+        assert e.value.code == 403
     finally:
         server.stop()
 
@@ -254,3 +275,26 @@ def test_job_secret_isolation(monkeypatch):
     assert job_secret.for_job({job_secret.ENV: "pinned"}) == "pinned"
     monkeypatch.setenv(job_secret.ENV, "from-env")
     assert job_secret.for_job(None) == "from-env"
+
+
+def test_secret_transport_keeps_key_off_argv():
+    """Local workers get the key via the subprocess env; the remote
+    wrapper reads it from stdin — in neither case does it appear in
+    the command string (argv is world-readable via /proc)."""
+    import subprocess
+    from horovod_tpu.runner.tpu_run import secret_transport
+
+    cmd, env, stdin = secret_transport("echo hi", "SECRET123",
+                                       local=True)
+    assert cmd == "echo hi" and stdin is None
+    assert env["HOROVOD_SECRET_KEY"] == "SECRET123"
+
+    cmd, env, stdin = secret_transport(
+        'echo "got:$HOROVOD_SECRET_KEY"', "SECRET123", local=False)
+    assert "SECRET123" not in cmd
+    assert env is None and stdin == b"SECRET123\n"
+    # The wrapper really delivers the key through a shell's stdin
+    # (stand-in for the far side of the ssh channel).
+    out = subprocess.run(cmd, shell=True, input=stdin,
+                         capture_output=True, timeout=30)
+    assert b"got:SECRET123" in out.stdout, out
