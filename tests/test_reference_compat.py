@@ -96,9 +96,11 @@ def test_alias_package_surface():
     assert horovod.__version__
     for mod, names in [
             (ht, ["DistributedOptimizer", "broadcast_parameters",
-                  "broadcast_optimizer_state", "allreduce_async"]),
+                  "broadcast_optimizer_state", "allreduce_async",
+                  "alltoall", "reducescatter", "join"]),
             (htf, ["DistributedGradientTape", "DistributedOptimizer",
-                   "broadcast_variables", "elastic"]),
+                   "broadcast_variables", "elastic", "alltoall",
+                   "reducescatter", "join"]),
             (htk, ["DistributedOptimizer", "callbacks"]),
             (hk, ["DistributedOptimizer", "callbacks"]),
             (hs, ["run", "Store", "FilesystemStore"]),
