@@ -23,6 +23,14 @@ process must call it); pass ``keep=N`` to bound retained steps. The
 ``template`` for restore supplies dtypes/shapes/shardings — pass the
 live pytree (restored arrays adopt its shardings) or
 ``jax.eval_shape``-style abstract values with shardings attached.
+
+Host-local leaves (step counters, scalars — anything not sharded over
+the global mesh) round-trip as replicated host values in multi-process
+jobs: ``save`` digest-checks them across processes (rank-divergent
+values raise rather than silently keeping one host's copy) and
+``restore`` returns them as numpy when ``process_count() > 1`` (as
+``jax.Array`` single-process). Keep templates for such leaves concrete
+(numpy/python/jax scalars), not sharded abstract values.
 """
 
 import logging
@@ -58,6 +66,54 @@ def _manager(directory: str, keep=_UNSET):
     return mgr
 
 
+def _host_local_to_numpy(state: Any, check_consistent: bool = False
+                         ) -> Any:
+    """In a multi-process job, host-local jax.Arrays (step counters,
+    scalars — anything not sharded over the global mesh) can't be
+    serialized collectively; save them as replicated host values
+    instead of making every caller pre-convert.
+
+    Replicated semantics mean orbax persists ONE host's value, so with
+    ``check_consistent`` the converted leaves are digest-compared
+    across processes and a mismatch raises — a rank-divergent
+    host-local value (per-host PRNG key, data cursor) silently
+    collapsing to process 0's copy would corrupt the resumed run."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return state
+
+    converted = []
+
+    def fix(path, x):
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
+            v = np.asarray(x)
+            converted.append((jax.tree_util.keystr(path), v))
+            return v
+        return x
+
+    out = jax.tree_util.tree_map_with_path(fix, state)
+    if check_consistent and converted:
+        digest = hashlib.sha256()
+        for name, v in converted:
+            digest.update(name.encode())
+            digest.update(np.ascontiguousarray(v).tobytes())
+        local = np.frombuffer(digest.digest()[:8], np.uint64)
+        from jax.experimental import multihost_utils
+        digests = np.asarray(multihost_utils.process_allgather(local))
+        if not (digests == digests[0]).all():
+            raise ValueError(
+                "host-local checkpoint leaves differ across processes "
+                f"({[n for n, _ in converted]}); a replicated save "
+                "would keep only one host's value. Shard rank-"
+                "divergent state over the mesh, or exclude it from "
+                "the checkpoint.")
+    return out
+
+
 def save(directory: str, state: Any, step: int, *,
          keep: Optional[int] = 3, block: bool = True) -> None:
     """Write ``state`` (a pytree of jax.Arrays / numpy / scalars) as
@@ -67,7 +123,8 @@ def save(directory: str, state: Any, step: int, *,
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, keep)
-    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.save(step, args=ocp.args.StandardSave(
+        _host_local_to_numpy(state, check_consistent=True)))
     if block:
         mgr.wait_until_finished()
 
@@ -97,7 +154,8 @@ def restore(directory: str, template: Any,
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {directory}")
-    return mgr.restore(step, args=ocp.args.StandardRestore(template))
+    return mgr.restore(step, args=ocp.args.StandardRestore(
+        _host_local_to_numpy(template)))
 
 
 def close() -> None:

@@ -94,3 +94,55 @@ def test_latest_step_empty_dir(tmp_path):
     assert ckpt.latest_step(tmp_path / "nothing_here") is None
     with pytest.raises(FileNotFoundError):
         ckpt.restore(tmp_path / "nothing_here", {})
+
+
+def test_multiprocess_sharded_save_restore(tmp_path):
+    """Collective save across 2 real processes: each writes only its
+    addressable shards of a process-spanning global array; restore
+    places shards back on the right devices (the multi-host contract
+    of horovod_tpu.jax.checkpoint)."""
+    from multiproc import assert_all_ok, run_workers
+
+    results = run_workers(f"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import horovod_tpu.jax.checkpoint as ckpt
+
+# One device per process; the mesh spans both processes.
+devs = np.array(sorted(jax.devices(), key=lambda d: d.id))
+mesh = Mesh(devs, ("dp",))
+sh = NamedSharding(mesh, P("dp"))
+rows_per = 4
+local = jnp.full((rows_per,), float(RANK), jnp.float32)
+g = jax.make_array_from_single_device_arrays(
+    (rows_per * SIZE,), sh,
+    [jax.device_put(local, [d for d in jax.devices()
+                            if d.process_index == jax.process_index()][0])])
+state = {{"w": g, "step": jnp.int32(7)}}
+ckpt.save(r"{tmp_path}", state, step=7)
+assert ckpt.latest_step(r"{tmp_path}") == 7
+
+template = {{"w": jax.device_put(jnp.zeros((rows_per * SIZE,),
+                                           jnp.float32), sh),
+            "step": jnp.int32(0)}}
+restored = ckpt.restore(r"{tmp_path}", template)
+mine = restored["w"].addressable_data(0)
+np.testing.assert_allclose(np.asarray(mine), float(RANK))
+assert int(restored["step"]) == 7
+
+# Rank-DIVERGENT host-local state must raise, not silently keep one
+# host's value (a replicated save stores a single copy).
+err = None
+try:
+    ckpt.save(r"{tmp_path}_bad", {{"cursor": jnp.int32(RANK)}}, step=1)
+except ValueError as e:
+    err = e
+assert err is not None and "differ across processes" in str(err), err
+ckpt.close()
+print("CKPT-MULTI OK", RANK)
+""", nproc=2, timeout=240)
+    assert_all_ok(results)
+    for _, out in results:
+        assert "CKPT-MULTI OK" in out
