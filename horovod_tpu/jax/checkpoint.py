@@ -19,7 +19,9 @@ Usage::
     state = ckpt.restore(dir, template=state, step=step)
 
 ``save`` is collective when jax.distributed is initialized (every
-process must call it); pass ``keep=N`` to bound retained steps. The
+process must call it). **Retention defaults to ``keep=3``** — older
+steps are pruned as new ones land; pass ``keep=None`` to retain every
+step (e.g. per-epoch savers that must keep full history). The
 ``template`` for restore supplies dtypes/shapes/shardings — pass the
 live pytree (restored arrays adopt its shardings) or
 ``jax.eval_shape``-style abstract values with shardings attached.
@@ -119,7 +121,10 @@ def save(directory: str, state: Any, step: int, *,
     """Write ``state`` (a pytree of jax.Arrays / numpy / scalars) as
     checkpoint ``step``. Collective across processes; with
     ``block=False`` the write completes in the background (call
-    :func:`wait` before shutdown)."""
+    :func:`wait` before shutdown).
+
+    PRUNES by default: only the newest ``keep=3`` steps are retained;
+    pass ``keep=None`` to keep every step."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, keep)

@@ -643,14 +643,19 @@ class Coordinator {
   // Single consumer: only the host poll thread calls this. On overflow
   // the oldest rounds are dropped.
   int DrainRoundBytes(int64_t* out, int cap) {
-    int64_t w = round_w_.load(std::memory_order_acquire);
     // Overflow clamp keeps half the ring as a safety margin: clamping
     // to exactly w - kRoundRing would put the read cursor on the slot
     // the writer fills next, and a commit racing the drain loop would
-    // hand the autotuner a torn int64.
-    if (w - round_r_ > kRoundRing / 2) round_r_ = w - kRoundRing / 2;
+    // hand the autotuner a torn int64.  Both the clamp and the
+    // published write cursor are re-evaluated EVERY iteration: a
+    // single snapshot of round_w_ would let a committer lapping the
+    // reader mid-loop overwrite slots the stale clamp still considered
+    // safe (torn values fed to the autotuner).
     int n = 0;
-    while (round_r_ < w && n < cap) {
+    while (n < cap) {
+      int64_t w = round_w_.load(std::memory_order_acquire);
+      if (w - round_r_ > kRoundRing / 2) round_r_ = w - kRoundRing / 2;
+      if (round_r_ >= w) break;
       out[n++] = round_bytes_[round_r_ % kRoundRing];
       ++round_r_;
     }

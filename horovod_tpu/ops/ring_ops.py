@@ -17,6 +17,7 @@ Selection (reference knob HOROVOD_CPU_OPERATIONS, common.h:84-89):
 import ctypes
 import logging
 import os
+import threading
 from typing import List
 
 import numpy as np
@@ -113,8 +114,13 @@ class RingBackend(Backend):
         self.stats = getattr(fallback, "stats", {})
         self.stats.setdefault("ring_allreduces", 0)
         # Persistent per-dtype staging buffers (reference:
-        # fusion_buffer_manager.{h,cc}) — see _fused().
+        # fusion_buffer_manager.{h,cc}) — see _fused().  Normally only
+        # the background runtime thread dispatches collectives, but
+        # allreduce/reducescatter are public; the lock makes a direct
+        # concurrent call serialize instead of corrupting the shared
+        # staging buffer.
         self._fusion_bufs = {}
+        self._fusion_lock = threading.Lock()
         self._lib = None
         self._comm = None
         self._keys = []
@@ -307,31 +313,34 @@ class RingBackend(Backend):
         # tensor into its own fresh output (the reference's
         # fusion-buffer memcpy in/out, collective_operations.h:96-125).
         total = sum(a.size for a in nps)
-        buf = self._fused(work_dt, total)
-        off = 0
-        for a in nps:
-            np.copyto(buf[off:off + a.size], a.reshape(-1),
-                      casting="unsafe")
-            off += a.size
-        self._scale_inplace(buf, prescale)
-        if total:
-            rc = self._lib.hvd_ring_allreduce(
-                self._comm, buf.ctypes.data_as(ctypes.c_void_p),
-                total, _DTYPES[work_dt], _OPS[reduce_op],
-                ranks_arr, nranks)
-            if rc != 0:
-                raise RuntimeError(f"ring allreduce failed (rc={rc})")
-        post = postscale
-        if reduce_op == "Average":
-            post = postscale / gsize
-        self._scale_inplace(buf, post)
-        out, off = [], 0
-        for a, odt, wj in zip(nps, orig_dtypes, was_jax):
-            piece = np.empty(a.shape, odt)
-            np.copyto(piece, buf[off:off + a.size].reshape(a.shape),
-                      casting="unsafe")
-            off += a.size
-            out.append(self._rewrap(piece, wj))
+        with self._fusion_lock:
+            buf = self._fused(work_dt, total)
+            off = 0
+            for a in nps:
+                np.copyto(buf[off:off + a.size], a.reshape(-1),
+                          casting="unsafe")
+                off += a.size
+            self._scale_inplace(buf, prescale)
+            if total:
+                rc = self._lib.hvd_ring_allreduce(
+                    self._comm, buf.ctypes.data_as(ctypes.c_void_p),
+                    total, _DTYPES[work_dt], _OPS[reduce_op],
+                    ranks_arr, nranks)
+                if rc != 0:
+                    raise RuntimeError(
+                        f"ring allreduce failed (rc={rc})")
+            post = postscale
+            if reduce_op == "Average":
+                post = postscale / gsize
+            self._scale_inplace(buf, post)
+            out, off = [], 0
+            for a, odt, wj in zip(nps, orig_dtypes, was_jax):
+                piece = np.empty(a.shape, odt)
+                np.copyto(piece,
+                          buf[off:off + a.size].reshape(a.shape),
+                          casting="unsafe")
+                off += a.size
+                out.append(self._rewrap(piece, wj))
         return out
 
     @staticmethod
@@ -490,23 +499,26 @@ class RingBackend(Backend):
             counts = [sum(rc[r] * re
                           for rc, re in zip(rowcounts, rowelems))
                       for r in range(gsize)]
-            buf = self._fused(work_dt, sum(counts))  # ring clobbers it
-            off = 0
-            row_off = [0] * len(items)
-            for r in range(gsize):
-                for j, (_, a, _) in enumerate(items):
-                    nel = rowcounts[j][r] * rowelems[j]
-                    src = a[row_off[j]:row_off[j] + rowcounts[j][r]]
-                    np.copyto(buf[off:off + nel], src.reshape(-1),
-                              casting="unsafe")
-                    row_off[j] += rowcounts[j][r]
-                    off += nel
-            counts_c = (ctypes.c_longlong * gsize)(*counts)
-            res = np.empty(counts[my_idx], work_dt)
-            rc = self._lib.hvd_ring_reducescatter(
-                self._comm, buf.ctypes.data_as(ctypes.c_void_p),
-                counts_c, _DTYPES[work_dt], _OPS[reduce_op],
-                res.ctypes.data_as(ctypes.c_void_p), ranks_arr, nranks)
+            with self._fusion_lock:
+                buf = self._fused(work_dt, sum(counts))  # clobbered
+                off = 0
+                row_off = [0] * len(items)
+                for r in range(gsize):
+                    for j, (_, a, _) in enumerate(items):
+                        nel = rowcounts[j][r] * rowelems[j]
+                        src = a[row_off[j]:
+                                row_off[j] + rowcounts[j][r]]
+                        np.copyto(buf[off:off + nel], src.reshape(-1),
+                                  casting="unsafe")
+                        row_off[j] += rowcounts[j][r]
+                        off += nel
+                counts_c = (ctypes.c_longlong * gsize)(*counts)
+                res = np.empty(counts[my_idx], work_dt)
+                rc = self._lib.hvd_ring_reducescatter(
+                    self._comm, buf.ctypes.data_as(ctypes.c_void_p),
+                    counts_c, _DTYPES[work_dt], _OPS[reduce_op],
+                    res.ctypes.data_as(ctypes.c_void_p), ranks_arr,
+                    nranks)
             if rc != 0:
                 raise RuntimeError(
                     f"ring reducescatter failed (rc={rc})")

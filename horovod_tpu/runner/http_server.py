@@ -62,6 +62,43 @@ class KVStore:
             return self._finalized.get(scope, False)
 
 
+class ReplayCache:
+    """Signatures accepted within the HMAC skew window.  A signed
+    request replayed by an eavesdropper (or a departed elastic worker)
+    hits a cached entry and is rejected — full anti-replay on top of
+    the timestamp window, bounded because entries expire with the
+    window itself."""
+
+    def __init__(self, window_s: float = job_secret.MAX_SKEW_S):
+        import collections
+        self._lock = threading.Lock()
+        self._seen: Dict[str, float] = {}
+        self._order = collections.deque()  # (accept time, sig)
+        self._window = window_s
+
+    def check_and_add(self, signature: str, now: float) -> bool:
+        """True if the signature is fresh (and records it).  Entries
+        are inserted in accept-time order, so expiry pops from the
+        deque head — O(expired) per call, never a full rebuild."""
+        with self._lock:
+            horizon = now - 2 * self._window
+            while self._order and self._order[0][0] <= horizon:
+                t, s = self._order.popleft()
+                if self._seen.get(s) == t:
+                    del self._seen[s]
+            if signature in self._seen:
+                return False
+            self._seen[signature] = now
+            self._order.append((now, signature))
+            return True
+
+
+# Rendezvous values are addresses, host plans and pickled run results —
+# small.  Bodies past this are rejected before the read so an
+# unauthenticated client can't stream memory at the driver.
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
 class KVStoreHandler(BaseHTTPRequestHandler):
     """Routes /scope/key to the server's KVStore.  Subclasses may
     override ``handle_get_special`` to serve computed scopes."""
@@ -84,17 +121,49 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         secret = getattr(self.server, "secret", None)
         if not secret:
             return True
-        if job_secret.verify(secret,
-                             self.headers.get(job_secret.HEADER),
+        sig = self.headers.get(job_secret.HEADER)
+        if job_secret.verify(secret, sig,
                              self.command, self.path, body,
                              self.headers.get(job_secret.TS_HEADER)):
-            return True
-        self.send_response(FORBIDDEN)
+            import time
+            cache = getattr(self.server, "replay_cache", None)
+            if cache is None or cache.check_and_add(sig, time.time()):
+                return True
+        return self._reject(FORBIDDEN)
+
+    def _reject(self, code: int) -> bool:
+        # A rejected PUT may have unread body bytes on the socket;
+        # keep-alive would misparse them as the next request line.
+        self.close_connection = True
+        self.send_response(code)
         self.send_header("Content-Length", "0")
         self.end_headers()
-        logger.warning("rejected unsigned %s %s from %s", self.command,
-                       self.path, self.client_address[0])
+        logger.warning("rejected %s %s from %s (%d)", self.command,
+                       self.path, self.client_address[0], code)
         return False
+
+    def _precheck_put(self, length: int) -> bool:
+        """Cheap gates BEFORE the body read: size cap plus
+        header-presence/timestamp-freshness checks.  The HMAC itself
+        covers the body, so full verification necessarily happens
+        after the read — this bounds, not eliminates, what an
+        unauthenticated client can make us buffer (<= MAX_BODY_BYTES
+        per connection)."""
+        if length > MAX_BODY_BYTES or length < 0:
+            return self._reject(BAD_REQUEST)
+        secret = getattr(self.server, "secret", None)
+        if not secret:
+            return True
+        ts = self.headers.get(job_secret.TS_HEADER)
+        if not self.headers.get(job_secret.HEADER) or not ts:
+            return self._reject(FORBIDDEN)
+        try:
+            import time
+            if abs(time.time() - float(ts)) > job_secret.MAX_SKEW_S:
+                return self._reject(FORBIDDEN)
+        except ValueError:
+            return self._reject(FORBIDDEN)
+        return True
 
     def do_GET(self):
         if not self._authorized():
@@ -114,7 +183,13 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_PUT(self):
-        length = int(self.headers.get("Content-Length", 0))
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._reject(BAD_REQUEST)
+            return
+        if not self._precheck_put(length):
+            return
         value = self.rfile.read(length)
         if not self._authorized(value):
             return
@@ -164,6 +239,7 @@ class RendezvousServer:
             ("0.0.0.0", self._requested_port), cls)
         self._httpd.kvstore = KVStore()
         self._httpd.secret = self._secret
+        self._httpd.replay_cache = ReplayCache()
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,

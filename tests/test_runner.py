@@ -246,6 +246,39 @@ def test_rendezvous_hmac_auth(monkeypatch):
         with pytest.raises(HTTPError) as e:
             urlopen(req, timeout=5)
         assert e.value.code == 403
+
+        # Anti-replay: a byte-identical resend of a correctly-signed
+        # PUT (captured on the wire / departed elastic worker) is
+        # rejected by the server-side signature cache even though the
+        # HMAC and timestamp still verify.
+        ts = repr(time.time())
+        sig = job_secret.sign(key, "PUT", "/s/replayed", b"v1", ts)
+
+        def signed_put():
+            r = Request(f"http://127.0.0.1:{port}/s/replayed",
+                        data=b"v1", method="PUT")
+            r.add_header(job_secret.TS_HEADER, ts)
+            r.add_header(job_secret.HEADER, sig)
+            return urlopen(r, timeout=5)
+
+        with signed_put():
+            pass
+        assert server.kvstore.get("s", "replayed") == b"v1"
+        with pytest.raises(HTTPError) as e:
+            signed_put()
+        assert e.value.code == 403
+
+        # PUT body gating: without a plausible signature header set,
+        # the body is never read (403 precedes the upload) and an
+        # over-cap Content-Length is a 400 outright.
+        from horovod_tpu.runner import http_server as hs
+        big = Request(f"http://127.0.0.1:{port}/s/huge", data=b"x",
+                      method="PUT")
+        big.add_header("Content-Length",
+                       str(hs.MAX_BODY_BYTES + 1))
+        with pytest.raises(HTTPError) as e:
+            urlopen(big, timeout=5)
+        assert e.value.code == 400
     finally:
         server.stop()
 
