@@ -338,6 +338,69 @@ def bench_collectives(sizes_mb, nproc=2, timeout=600) -> dict:
     return {"error": "no result line: %s" % outs[0][-800:]}
 
 
+LAST_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_LAST_TPU.json")
+
+
+def probe_tpu(timeout_s: float = None):
+    """Liveness-check the TPU in a THROWAWAY subprocess with a hard
+    timeout.  A wedged axon device claim makes ``jax.devices()`` block
+    ~25 minutes before failing — inside the driver's bench run that
+    would eat the whole budget, so the main process never touches the
+    TPU backend until a bounded probe has seen it respond.
+    Returns (device_info_dict | None, error | None)."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(
+            "HOROVOD_BENCH_TPU_PROBE_TIMEOUT", 120))
+    src = ("import json, jax\n"
+           "d = jax.devices()[0]\n"
+           "print('PROBE ' + json.dumps("
+           "{'platform': d.platform, "
+           "'kind': getattr(d, 'device_kind', str(d))}))\n")
+    try:
+        cp = subprocess.run([sys.executable, "-c", src],
+                            capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, ("TPU probe timed out after %.0fs (wedged device "
+                      "claim?)" % timeout_s)
+    txt = (cp.stdout + cp.stderr).decode(errors="replace")
+    if cp.returncode != 0:
+        return None, "TPU probe failed: %s" % txt[-300:]
+    for line in txt.splitlines():
+        if line.startswith("PROBE "):
+            info = json.loads(line[len("PROBE "):])
+            if info.get("platform") == "cpu":
+                return None, "probe saw only CPU devices"
+            return info, None
+    return None, "TPU probe produced no output"
+
+
+def save_last_tpu(out: dict):
+    """Persist a successful full-size TPU result so a later tunnel
+    outage can still surface driver-verifiable evidence (clearly
+    labeled stale) instead of leaving the round evidence-free."""
+    try:
+        with open(LAST_TPU_CACHE, "w") as f:
+            json.dump({"timestamp": time.time(),
+                       "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+                       "result": out}, f, indent=1)
+    except OSError:
+        pass
+
+
+def load_last_tpu():
+    try:
+        with open(LAST_TPU_CACHE) as f:
+            cached = json.load(f)
+        cached["stale"] = True
+        cached["age_hours"] = round(
+            (time.time() - cached.get("timestamp", 0)) / 3600, 1)
+        return cached
+    except (OSError, ValueError):
+        return None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true",
@@ -353,23 +416,25 @@ def main():
                    default=None)
     args = p.parse_args()
 
-    if args.smoke:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    import jax
-
     tpu_error = None
+    if not args.smoke:
+        # Bounded probe BEFORE the first in-process jax backend use;
+        # on failure force CPU so the wedged claim is never touched.
+        _info, tpu_error = probe_tpu()
+    import jax
+    if args.smoke or tpu_error:
+        jax.config.update("jax_platforms", "cpu")
+
     try:
         dev = jax.devices()[0]
     except RuntimeError as e:
-        # TPU tunnel unavailable (e.g. a wedged device claim): fall
-        # back to CPU so the driver still records an honest JSON line
-        # — platform and the error are carried in the output instead
-        # of an empty BENCH file.
+        # Probe raced a fresh wedge: fall back to CPU so the driver
+        # still records an honest JSON line.
         tpu_error = repr(e)[:300]
         jax.config.update("jax_platforms", "cpu")
-        args.smoke = True
         dev = jax.devices()[0]
+    if tpu_error:
+        args.smoke = True
     out = {
         "device": {"kind": getattr(dev, "device_kind", str(dev)),
                    "platform": dev.platform,
@@ -409,6 +474,20 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(img_sec / REFERENCE_IMG_SEC_PER_DEVICE, 3),
     })
+    benches_ok = img_sec > 0 and not any(
+        isinstance(v, dict) and "error" in v for v in out.values())
+    if dev.platform != "cpu" and not args.smoke and not args.only \
+            and benches_ok:
+        # Only a run that actually produced a headline metric (and no
+        # failed sub-bench) may become the cached "last good" evidence.
+        save_last_tpu(out)
+    elif tpu_error:
+        # Tunnel outage: carry the last driver-verifiable TPU result
+        # (clearly marked stale, with its age) next to the CPU
+        # fallback numbers.
+        cached = load_last_tpu()
+        if cached:
+            out["last_tpu"] = cached
     print(json.dumps(out))
 
 

@@ -125,6 +125,15 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         if job_secret.verify(secret, sig,
                              self.command, self.path, body,
                              self.headers.get(job_secret.TS_HEADER)):
+            # Replay rejection applies to MUTATING methods only (the
+            # threat is a replayed PUT poisoning a later re-rendezvous
+            # round).  GETs are excluded deliberately: wait_get polls
+            # the same path at 10 Hz from many workers, so two
+            # pollers' time.time() floats can legitimately collide
+            # into an identical signature — and caching read-only
+            # requests buys nothing.
+            if self.command == "GET":
+                return True
             import time
             cache = getattr(self.server, "replay_cache", None)
             if cache is None or cache.check_and_add(sig, time.time()):
@@ -154,14 +163,9 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         secret = getattr(self.server, "secret", None)
         if not secret:
             return True
-        ts = self.headers.get(job_secret.TS_HEADER)
-        if not self.headers.get(job_secret.HEADER) or not ts:
-            return self._reject(FORBIDDEN)
-        try:
-            import time
-            if abs(time.time() - float(ts)) > job_secret.MAX_SKEW_S:
-                return self._reject(FORBIDDEN)
-        except ValueError:
+        if not self.headers.get(job_secret.HEADER) or \
+                not job_secret.ts_fresh(
+                    self.headers.get(job_secret.TS_HEADER)):
             return self._reject(FORBIDDEN)
         return True
 

@@ -62,18 +62,26 @@ def sign(secret: str, method: str, path: str, body: bytes,
     return mac.hexdigest()
 
 
-def verify(secret: str, signature: Optional[str], method: str,
-           path: str, body: bytes, timestamp: Optional[str],
-           max_skew_s: float = MAX_SKEW_S) -> bool:
+def ts_fresh(timestamp: Optional[str],
+             max_skew_s: float = MAX_SKEW_S) -> bool:
+    """Is the signed timestamp parseable and within the skew window?
+    Shared by full verification and the server's pre-body-read gate so
+    the freshness rule can never diverge between the two."""
     import time
 
-    if not signature or not timestamp:
+    if not timestamp:
         return False
     try:
         ts = float(timestamp)
     except ValueError:
         return False
-    if abs(time.time() - ts) > max_skew_s:
+    return abs(time.time() - ts) <= max_skew_s
+
+
+def verify(secret: str, signature: Optional[str], method: str,
+           path: str, body: bytes, timestamp: Optional[str],
+           max_skew_s: float = MAX_SKEW_S) -> bool:
+    if not signature or not ts_fresh(timestamp, max_skew_s):
         return False
     try:
         expected = sign(secret, method, path, body, timestamp)
