@@ -368,10 +368,11 @@ def probe_tpu(timeout_s: float = None):
         return None, "TPU probe failed: %s" % txt[-300:]
     for line in txt.splitlines():
         if line.startswith("PROBE "):
-            info = json.loads(line[len("PROBE "):])
-            if info.get("platform") == "cpu":
-                return None, "probe saw only CPU devices"
-            return info, None
+            # A clean CPU-only answer is NOT an outage — the host
+            # simply has no TPU; the caller runs the full-size bench
+            # on CPU exactly as before.  Only timeouts/errors above
+            # are treated as a wedged tunnel.
+            return json.loads(line[len("PROBE "):]), None
     return None, "TPU probe produced no output"
 
 

@@ -39,7 +39,7 @@ class EstimatorParams(Params):
 class ModelParams(Params):
     _params = dict(
         model=None, feature_cols=None, label_cols=None,
-        output_cols=None, run_id=None,
+        output_cols=None, run_id=None, metadata=None,
     )
 
     def get_output_cols(self) -> List[str]:
@@ -83,7 +83,12 @@ class HorovodEstimator(EstimatorParams):
             resume_state = store.read(store.get_checkpoint_path(run_id))
         trainer = self._remote_trainer(meta, resume_state, run_id)
         results = backend.run(trainer)
-        return self._create_model(results[0], run_id)
+        model = self._create_model(results[0], run_id)
+        # Column metadata rides along so transform() can derive its
+        # output schema without collecting data to the driver.
+        if model.getMetadata() is None:
+            model.setMetadata(meta)
+        return model
 
     # -- checkpoint/resume (reference: estimator.py:90-94,
     #    torch/remote.py:139-141,190-200) ------------------------------
@@ -130,6 +135,25 @@ class HorovodModel(ModelParams):
             out[col] = list(np.asarray(pred))
         return out
 
+    def _output_ranks(self):
+        """Per-output-column prediction rank (row dims), derived by
+        running ``_predict`` on a SYNTHETIC zero batch built from the
+        Store's column metadata — exact (it exercises the real model)
+        yet driver-side-data-free: works on empty DataFrames and never
+        collects feature rows to the driver."""
+        import numpy as np
+        meta = self.getMetadata()
+        cols = (meta or {}).get("columns", {})
+        feats = []
+        for c in self.getFeatureCols():
+            info = cols.get(c)
+            if info is None or "dtype" not in info:
+                return None           # insufficient metadata: fallback
+            feats.append(np.zeros((1, *info.get("shape", [])),
+                                  dtype=np.dtype(info["dtype"])))
+        preds = self._predict(feats)
+        return [max(np.asarray(p).ndim - 1, 0) for p in preds]
+
     def _transform_spark(self, df):
         """Distributed transform: one model instance per task, no
         driver-side collect (reference transforms via a UDF,
@@ -138,14 +162,19 @@ class HorovodModel(ModelParams):
         from pyspark.sql.types import (ArrayType, FloatType, StructField,
                                        StructType)
         # Output schema: input schema + one field per prediction
-        # column; shape probed on a single driver-side row.
-        sample = df.limit(1).toPandas()
-        probe = self._transform_pandas(sample)
+        # column, ranks inferred from a synthetic metadata-shaped
+        # batch.  Legacy fallback (model built without metadata, e.g.
+        # hand-constructed): probe one collected row.
+        ranks = self._output_ranks()
+        if ranks is None:
+            sample = df.limit(1).toPandas()
+            probe = self._transform_pandas(sample)
+            ranks = [max(np.asarray(probe[col].tolist()).ndim - 1, 0)
+                     for col in self.get_output_cols()]
         fields = list(df.schema.fields)
-        for col in self.get_output_cols():
-            val = np.asarray(probe[col].tolist())
+        for col, rank in zip(self.get_output_cols(), ranks):
             typ = FloatType()
-            for _ in range(max(val.ndim - 1, 0)):   # nest per row dim
+            for _ in range(rank):                   # nest per row dim
                 typ = ArrayType(typ)
             fields.append(StructField(col, typ))
         schema = StructType(fields)
