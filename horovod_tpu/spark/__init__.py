@@ -15,13 +15,15 @@ from typing import Callable, List, Optional
 from ..runner.hosts import HostInfo, get_host_assignments, slot_env_vars
 from ..runner.http_server import RendezvousServer, find_ports, \
     local_addresses
-from .store import FilesystemStore, Store
+from .store import (FilesystemStore, FsspecStore, GCSStore,
+                    HDFSStore, S3Store, Store)
 from .backend import Backend, LocalBackend, SparkBackend
 from .estimator import HorovodEstimator, HorovodModel
 
 logger = logging.getLogger("horovod_tpu.spark")
 
-__all__ = ["run", "Store", "FilesystemStore", "Backend", "LocalBackend",
+__all__ = ["run", "Store", "FilesystemStore", "FsspecStore",
+           "HDFSStore", "S3Store", "GCSStore", "Backend", "LocalBackend",
            "SparkBackend", "HorovodEstimator", "HorovodModel"]
 
 

@@ -57,6 +57,7 @@ class KerasEstimator(HorovodEstimator):
         cols = feature_cols + label_cols
         epochs = self.getEpochs()
         batch_size = self.getBatchSize()
+        seed = self._get("seed") or 0
         verbose = self.getVerbose()
         user_callbacks = self.getCallbacks() or []
         loss = self.getLoss()
@@ -117,11 +118,45 @@ class KerasEstimator(HorovodEstimator):
                         "between resumes?); continuing with fresh "
                         "optimizer slots")
 
-            shard = util.data_shards(store, "train", rank, size, cols)
-            x = [shard[c] for c in feature_cols]
-            y = [shard[c] for c in label_cols]
-            x = x[0] if len(x) == 1 else x
-            y = y[0] if len(y) == 1 else y
+            # Streaming input: one part file resident at a time, so
+            # shards larger than worker memory train fine (reference:
+            # Petastorm row-group streaming).  The generator runs
+            # epoch passes back to back with a fresh shuffle seed per
+            # pass; steps_per_epoch (from metadata row counts) tells
+            # keras where the epoch boundary is.
+            my_rows = util.shard_rows(meta, "train", rank, size)
+            if my_rows == 0:
+                raise ValueError(
+                    f"rank {rank} of {size} has no training rows "
+                    f"({meta.get('train_rows', 0)} total); use fewer "
+                    "workers or more data")
+            steps_per_epoch = max(my_rows // batch_size, 1)
+            nfeat = len(feature_cols)
+
+            def epoch_pass(e, drop):
+                n = 0
+                for b in util.stream_batches(
+                        store, "train", rank, size, cols, batch_size,
+                        seed=seed + e, drop_remainder=drop):
+                    bx, by = list(b[:nfeat]), list(b[nfeat:])
+                    yield (bx[0] if nfeat == 1 else bx,
+                           by[0] if len(by) == 1 else by)
+                    n += 1
+                if not n and drop:
+                    # Shard smaller than one batch: emit the short
+                    # remainder so fit() never starves.
+                    yield from epoch_pass(e, False)
+                elif not n:
+                    raise RuntimeError(
+                        f"rank {rank}: no batches streamed from "
+                        f"{store.get_train_data_path()} (metadata "
+                        f"promised {my_rows} rows)")
+
+            def gen():
+                e = start_epoch
+                while True:
+                    yield from epoch_pass(e, True)
+                    e += 1
 
             cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]
             if rank == 0:
@@ -138,10 +173,10 @@ class KerasEstimator(HorovodEstimator):
 
             history = {}
             if start_epoch < epochs:
-                h = model.fit(x, y, batch_size=batch_size,
+                h = model.fit(gen(), steps_per_epoch=steps_per_epoch,
                               initial_epoch=start_epoch, epochs=epochs,
                               verbose=verbose if rank == 0 else 0,
-                              shuffle=True, callbacks=cbs)
+                              callbacks=cbs)
                 history = {k: [float(v) for v in vs]
                            for k, vs in h.history.items()}
             result = {"history": history, "start_epoch": start_epoch}

@@ -82,13 +82,14 @@ class TorchEstimator(HorovodEstimator):
             hvd.broadcast_parameters(net.state_dict(), root_rank=0)
             hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
-            shard = util.data_shards(store, "train", rank, size, cols)
-
             history = []
             for epoch in range(start_epoch, epochs):
                 epoch_loss, steps = 0.0, 0
-                for batch in util.batches(
-                        shard, cols, batch_size,
+                # Streaming iterator: one part file resident at a time,
+                # so shards larger than worker memory train fine
+                # (reference: Petastorm row-group streaming).
+                for batch in util.stream_batches(
+                        store, "train", rank, size, cols, batch_size,
                         seed=seed + epoch, drop_remainder=False):
                     bx = [torch.as_tensor(b).float()
                           for b in batch[:len(feature_cols)]]

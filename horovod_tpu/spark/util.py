@@ -76,6 +76,9 @@ def prepare_data(num_partitions: int, store, df,
         idx = np.nonzero(mask)[0]
         rng.shuffle(idx)
         parts = np.array_split(idx, num_partitions)
+        # Per-part row counts ride the metadata so workers can size
+        # steps_per_epoch without opening a single shard.
+        meta[f"{split}_part_rows"] = [len(p) for p in parts]
         for i, part in enumerate(parts):
             shard = {c: arrays[c][part] for c in cols}
             buf = io.BytesIO()
@@ -115,6 +118,66 @@ def data_shards(store, split: str, rank: int, size: int,
                 out[c].append(z[c])
     return {c: (np.concatenate(v) if v else np.zeros((0,)))
             for c, v in out.items()}
+
+
+def stream_batches(store, split: str, rank: int, size: int,
+                   cols: Sequence[str], batch_size: int,
+                   seed: int = 0, shuffle: bool = True,
+                   drop_remainder: bool = False):
+    """Streaming batch iterator over this rank's partitions: at most
+    ONE part file is resident at a time, so datasets larger than
+    worker memory train fine as long as individual partitions fit
+    (reference: the Estimator streams Petastorm parquet row-groups,
+    spark/common/estimator.py:25-108 + petastorm readers).
+
+    Shuffle granularity matches Petastorm's trade: part-file order and
+    within-part row order are reshuffled per seed (pass seed+epoch for
+    a fresh epoch order); rows never shuffle ACROSS parts — prepare
+    shuffles rows into parts once at materialization, so the
+    two-level shuffle approximates a global one.  Remainder rows of
+    each part carry into the next part's first batch; a final short
+    batch is yielded unless ``drop_remainder``.
+    """
+    path = (store.get_train_data_path() if split == "train"
+            else store.get_val_data_path())
+    parts = sorted(store.list(path, "part-*.npz"))
+    mine = list(parts[rank::size])
+    rng = np.random.RandomState(seed)
+    if shuffle:
+        rng.shuffle(mine)
+    leftover: Optional[Dict[str, np.ndarray]] = None  # < batch_size
+
+    for p in mine:
+        with store.open_read(p) as f, np.load(f) as z:
+            block = {c: z[c] for c in cols}
+        n = len(next(iter(block.values()))) if block else 0
+        if n == 0:
+            continue
+        if shuffle:
+            idx = rng.permutation(n)
+            block = {c: v[idx] for c, v in block.items()}
+        if leftover is not None:
+            block = {c: np.concatenate([leftover[c], block[c]])
+                     for c in cols}
+            n = len(next(iter(block.values())))
+            leftover = None
+        stop = (n // batch_size) * batch_size
+        for s in range(0, stop, batch_size):
+            yield tuple(block[c][s:s + batch_size] for c in cols)
+        if stop < n:
+            leftover = {c: block[c][stop:] for c in cols}
+    if leftover is not None and not drop_remainder:
+        yield tuple(leftover[c] for c in cols)
+
+
+def shard_rows(meta: Dict, split: str, rank: int, size: int) -> int:
+    """Rows this rank will stream for ``split``, from metadata alone.
+    Falls back to the split's total/size estimate for metadata written
+    before per-part counts existed."""
+    part_rows = meta.get(f"{split}_part_rows")
+    if part_rows is not None:
+        return int(sum(part_rows[rank::size]))
+    return int(meta.get(f"{split}_rows", 0)) // max(size, 1)
 
 
 def batches(shard: Dict[str, np.ndarray], cols: Sequence[str],

@@ -3,6 +3,8 @@ Estimator against local-mode Spark; here the LocalBackend stands in —
 same remote-trainer path, real multi-process workers, no cluster).
 """
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -68,6 +70,166 @@ def test_validation_column_split(tmp_path):
     meta = sutil.prepare_data(2, store, df, feature_cols=["x"],
                               label_cols=["y"], validation="is_val")
     assert meta["val_rows"] == 5 and meta["train_rows"] == 15
+
+
+class TrackingStore(FilesystemStore):
+    """Counts bulk read()s and concurrently-open streaming handles so
+    tests can prove the memory bound of the streaming iterator."""
+
+    def __init__(self, prefix):
+        super().__init__(prefix)
+        self.bulk_part_reads = 0
+        self.open_now = 0
+        self.open_peak = 0
+
+    def read(self, path):
+        if "part-" in os.path.basename(path):
+            self.bulk_part_reads += 1
+        return super().read(path)
+
+    def open_read(self, path):
+        f = super().open_read(path)
+        self.open_now += 1
+        self.open_peak = max(self.open_peak, self.open_now)
+        orig_close, outer = f.close, self
+
+        def close():
+            outer.open_now -= 1
+            orig_close()
+
+        f.close = close
+        return f
+
+
+def test_stream_batches_bounded_residency(tmp_path):
+    """VERDICT r3 #4: larger-than-memory shards — the streaming
+    iterator must hold at most ONE part file open at a time and never
+    bulk-read() part files, while covering exactly the same rows as
+    the in-memory loader (remainders carried across parts)."""
+    store = TrackingStore(str(tmp_path))
+    sutil.prepare_data(8, store, _df(103), feature_cols=["x"],
+                       label_cols=["y"])
+    for rank in range(2):
+        got = list(sutil.stream_batches(store, "train", rank, 2,
+                                        ["x", "y"], batch_size=10,
+                                        shuffle=False))
+        # Parts are ~13 rows; batch 10 forces remainder carry.
+        rows = np.concatenate([b[0] for b in got])
+        shard = sutil.data_shards(store, "train", rank, 2, ["x", "y"])
+        np.testing.assert_allclose(np.sort(rows), np.sort(shard["x"]))
+        assert all(len(b[0]) == 10 for b in got[:-1])
+    assert store.open_peak == 1
+    store.bulk_part_reads = 0
+    list(sutil.stream_batches(store, "train", 0, 2, ["x", "y"], 10))
+    assert store.bulk_part_reads == 0
+
+    # metadata row counts match streaming reality
+    meta = sutil.read_metadata(store)
+    for rank in range(2):
+        got = list(sutil.stream_batches(store, "train", rank, 2,
+                                        ["x", "y"], 10, shuffle=False))
+        assert sum(len(b[0]) for b in got) == \
+            sutil.shard_rows(meta, "train", rank, 2)
+
+
+def test_stream_batches_epoch_reshuffle(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+    sutil.prepare_data(4, store, _df(64), feature_cols=["x"],
+                       label_cols=["y"])
+    a = np.concatenate([b[0] for b in sutil.stream_batches(
+        store, "train", 0, 1, ["x", "y"], 8, seed=1)])
+    b = np.concatenate([b[0] for b in sutil.stream_batches(
+        store, "train", 0, 1, ["x", "y"], 8, seed=2)])
+    assert not np.array_equal(a, b)          # different epoch order
+    np.testing.assert_allclose(np.sort(a), np.sort(b))  # same rows
+
+
+def test_fsspec_store_round_trip():
+    """VERDICT r3 #3: HDFS/S3-class stores via fsspec; round-trip on
+    the fsspec memory filesystem (reference: spark/common/store.py:
+    32-150 HDFSStore/S3Store)."""
+    from horovod_tpu.spark.store import (FsspecStore, GCSStore,
+                                         HDFSStore, S3Store, Store)
+    import uuid
+
+    store = Store.create(f"memory://est-{uuid.uuid4().hex}")
+    assert isinstance(store, FsspecStore)
+
+    # KV surface
+    ckpt = store.get_checkpoint_path("r1")
+    assert not store.exists(ckpt)
+    store.write(ckpt, b"payload")
+    assert store.exists(ckpt) and store.read(ckpt) == b"payload"
+    with store.open_read(ckpt) as f:
+        assert f.read() == b"payload"
+    store.delete(store.get_run_path("r1"))
+    assert not store.exists(ckpt)
+
+    # full prepare/stream cycle on the remote store
+    meta = sutil.prepare_data(3, store, _df(30), feature_cols=["x"],
+                              label_cols=["y"])
+    got = list(sutil.stream_batches(store, "train", 0, 1, ["x", "y"],
+                                    8, shuffle=False))
+    assert sum(len(b[0]) for b in got) == meta["train_rows"]
+
+    # scheme dispatch + guardrails
+    assert Store.create("/tmp/x").__class__.__name__ == \
+        "FilesystemStore"
+    for cls, url in ((S3Store, "s3://b/p"), (HDFSStore, "hdfs://n/p"),
+                     (GCSStore, "gs://b/p")):
+        assert type(Store.create(url)) is cls
+    with pytest.raises(ValueError):
+        S3Store("file:///tmp/x")
+
+
+def test_torch_estimator_streams_from_memory_store(tmp_path):
+    """End-to-end: the torch estimator trains out of an fsspec
+    memory:// store through the streaming path — proving the trainer
+    needs neither a local filesystem nor a whole-shard load.  (The
+    LocalBackend would pickle the store into subprocess workers, and
+    fsspec memory filesystems are per-process — so this uses an
+    in-process backend to keep the memory store shared.)"""
+    torch = pytest.importorskip("torch")
+    import uuid
+    from horovod_tpu.spark.backend import Backend
+    from horovod_tpu.spark.store import Store
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    class InprocBackend(Backend):
+        def num_processes(self):
+            return 1
+
+        def run(self, fn, args=(), extra_env=None):
+            env = {"HOROVOD_RANK": "0", "HOROVOD_SIZE": "1",
+                   "HOROVOD_LOCAL_RANK": "0", "HOROVOD_LOCAL_SIZE": "1",
+                   "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+                   "HOROVOD_TPU_FORCE_CPU": "1", **(extra_env or {})}
+            old = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            try:
+                return [fn(*args)]
+            finally:
+                for k, v in old.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+    store = Store.create(f"memory://est-{uuid.uuid4().hex}")
+    net = torch.nn.Linear(1, 1)
+    est = TorchEstimator(
+        model=net,
+        optimizer=torch.optim.SGD(net.parameters(), lr=0.5),
+        loss=torch.nn.MSELoss(),
+        feature_cols=["x"], label_cols=["y"],
+        store=store, backend=InprocBackend(), epochs=3, batch_size=8,
+        run_id="memrun", verbose=0)
+    df = _df(64)
+    df["x"] = df["x"].apply(lambda v: [v])
+    model = est.fit(df)
+    assert model.history[-1] < model.history[0]
+    out = model.transform(df.head(8))
+    assert "y__output" in out.columns
 
 
 # ---------------------------------------------------------------------------
