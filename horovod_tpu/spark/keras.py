@@ -125,12 +125,11 @@ class KerasEstimator(HorovodEstimator):
             # pass; steps_per_epoch (from metadata row counts) tells
             # keras where the epoch boundary is.
             my_rows = util.shard_rows(meta, "train", rank, size)
-            if my_rows == 0:
-                raise ValueError(
-                    f"rank {rank} of {size} has no training rows "
-                    f"({meta.get('train_rows', 0)} total); use fewer "
-                    "workers or more data")
-            steps_per_epoch = max(my_rows // batch_size, 1)
+            # The SAME step count on every rank: the per-batch gradient
+            # allreduce would otherwise desync on unequal shards and
+            # hang the larger ranks at end of fit.
+            steps_per_epoch = util.sync_steps_per_epoch(
+                meta, "train", size, batch_size)
             nfeat = len(feature_cols)
 
             def epoch_pass(e, drop):

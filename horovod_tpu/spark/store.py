@@ -245,9 +245,9 @@ class HDFSStore(FsspecStore):
     """``hdfs://`` store (reference: spark/common/store.py HDFSStore)."""
     SCHEME = ("hdfs",)
 
-    def __init__(self, prefix_url: str, **kwargs):
+    def __init__(self, prefix_url: str, *args, **kwargs):
         _check_scheme(prefix_url, self.SCHEME, type(self).__name__)
-        super().__init__(prefix_url, **kwargs)
+        super().__init__(prefix_url, *args, **kwargs)
 
 
 class S3Store(FsspecStore):
@@ -255,9 +255,9 @@ class S3Store(FsspecStore):
     s3fs)."""
     SCHEME = ("s3", "s3a", "s3n")
 
-    def __init__(self, prefix_url: str, **kwargs):
+    def __init__(self, prefix_url: str, *args, **kwargs):
         _check_scheme(prefix_url, self.SCHEME, type(self).__name__)
-        super().__init__(prefix_url, **kwargs)
+        super().__init__(prefix_url, *args, **kwargs)
 
 
 class GCSStore(FsspecStore):
@@ -265,9 +265,9 @@ class GCSStore(FsspecStore):
     because GCS is the object store adjacent to TPU pods."""
     SCHEME = ("gs", "gcs")
 
-    def __init__(self, prefix_url: str, **kwargs):
+    def __init__(self, prefix_url: str, *args, **kwargs):
         _check_scheme(prefix_url, self.SCHEME, type(self).__name__)
-        super().__init__(prefix_url, **kwargs)
+        super().__init__(prefix_url, *args, **kwargs)
 
 
 def _check_scheme(url: str, schemes, cls_name: str):

@@ -63,6 +63,7 @@ class TorchEstimator(HorovodEstimator):
                        if resume_state is not None else 0)
 
         def trainer():
+            import itertools
             import numpy as np
             import torch
             import horovod_tpu.torch as hvd
@@ -82,15 +83,22 @@ class TorchEstimator(HorovodEstimator):
             hvd.broadcast_parameters(net.state_dict(), root_rank=0)
             hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
+            # The SAME step count on every rank: the per-batch gradient
+            # allreduce would otherwise desync on unequal shards and
+            # hang the larger ranks at epoch end.
+            max_steps = util.sync_steps_per_epoch(
+                meta, "train", size, batch_size, ceil=True)
+
             history = []
             for epoch in range(start_epoch, epochs):
                 epoch_loss, steps = 0.0, 0
                 # Streaming iterator: one part file resident at a time,
                 # so shards larger than worker memory train fine
                 # (reference: Petastorm row-group streaming).
-                for batch in util.stream_batches(
+                for batch in itertools.islice(util.stream_batches(
                         store, "train", rank, size, cols, batch_size,
-                        seed=seed + epoch, drop_remainder=False):
+                        seed=seed + epoch, drop_remainder=False),
+                        max_steps):
                     bx = [torch.as_tensor(b).float()
                           for b in batch[:len(feature_cols)]]
                     by = [torch.as_tensor(b).float()

@@ -172,12 +172,35 @@ def stream_batches(store, split: str, rank: int, size: int,
 
 def shard_rows(meta: Dict, split: str, rank: int, size: int) -> int:
     """Rows this rank will stream for ``split``, from metadata alone.
-    Falls back to the split's total/size estimate for metadata written
-    before per-part counts existed."""
+    Metadata written before per-part counts existed falls back to an
+    even distribution of the split total (never rounding a nonempty
+    split down to 0 rows for low ranks)."""
     part_rows = meta.get(f"{split}_part_rows")
     if part_rows is not None:
         return int(sum(part_rows[rank::size]))
-    return int(meta.get(f"{split}_rows", 0)) // max(size, 1)
+    total = int(meta.get(f"{split}_rows", 0))
+    base, rem = divmod(total, max(size, 1))
+    return base + (1 if rank < rem else 0)
+
+
+def sync_steps_per_epoch(meta: Dict, split: str, size: int,
+                         batch_size: int, ceil: bool = False) -> int:
+    """Per-epoch step count EVERY rank can run: the minimum over
+    ranks' shard sizes.  Synchronous DP allreduces once per batch, so
+    a rank running extra steps would block forever in a collective its
+    peers never join (reference: the coordinator only fires a tensor
+    once all ranks submit it, controller.cc IncrementTensorCount).
+    Raises if any rank would stream nothing at all."""
+    rows = [shard_rows(meta, split, r, size) for r in range(size)]
+    if min(rows) == 0:
+        empty = [r for r, n in enumerate(rows) if n == 0]
+        raise ValueError(
+            f"rank(s) {empty} of {size} have no {split} rows "
+            f"({meta.get(f'{split}_rows', 0)} total); use fewer "
+            "workers or more data")
+    if ceil:
+        return max(min(-(-n // batch_size) for n in rows), 1)
+    return max(min(n // batch_size for n in rows), 1)
 
 
 def batches(shard: Dict[str, np.ndarray], cols: Sequence[str],

@@ -25,6 +25,7 @@ Constraints inherited from TF:
   order-independent) is unaffected.
 """
 
+import hashlib
 import logging
 import os
 import socket
@@ -68,6 +69,12 @@ class _GraphCollectives:
         self.env_enabled = os.environ.get(
             "HOROVOD_TF_GRAPH_COLLECTIVES", "1").strip().lower() \
             not in ("0", "false", "off")
+        # Debug: trace-time key-agreement verification (see key_check).
+        self.key_check_enabled = os.environ.get(
+            "HOROVOD_TF_COLLECTIVE_KEY_CHECK", "").strip().lower() \
+            in ("1", "true", "on")
+        self._check_seq = 0
+        self._key_hash = ""
 
     # -- lifecycle -------------------------------------------------------
     def enable(self) -> bool:
@@ -214,6 +221,66 @@ class _GraphCollectives:
             self._instance_key += 1
             return self._instance_key
 
+    def key_check(self, kind: str, instance_key: int, group_key: int,
+                  dtype, shape, name):
+        """Trace-time divergence detector (debug knob
+        ``HOROVOD_TF_COLLECTIVE_KEY_CHECK=1``).
+
+        Instance keys are assigned in trace order; rank-divergent
+        conditional tracing silently pairs DIFFERENT collectives under
+        the SAME key and deadlocks (or corrupts) at execution time.
+        With the knob set, every emitted collective allgathers a
+        record of (kind, instance key, group key, dtype, shape) plus
+        a rolling hash of the whole emission history over the eager
+        control plane, and raises at the FIRST divergent op — naming
+        it — instead of hanging in TF's collective executor.  The
+        reference does the analogous validation on the coordinator
+        (controller.cc:471-748 shape/dtype mismatch -> ERROR
+        response).
+
+        The exchange is sequence-numbered (not keyed by instance key)
+        so ranks that disagree on keys still meet in the same
+        negotiation round.  If a rank stops emitting entirely, the
+        other ranks' next exchange parks in the negotiated allgather,
+        where the stall inspector attributes the missing rank — still
+        strictly better than a bare TF deadlock.  Trace-time only:
+        zero cost at step time.
+        """
+        if not self.key_check_enabled or basics.size() == 1:
+            return
+        from ..jax import allgather_object
+
+        with self._lock:
+            seq = self._check_seq
+            self._check_seq += 1
+            rec = (kind, instance_key, group_key, str(dtype),
+                   str(tuple(shape) if shape is not None else None),
+                   str(name or ""))
+            self._key_hash = hashlib.sha256(
+                (self._key_hash + repr(rec)).encode()).hexdigest()
+            payload = (self._key_hash, rec)
+        views = allgather_object(
+            payload, name=f"tf_graph_collectives.keycheck.{seq}")
+        # Equality is judged on the RECORDS (each emission is checked
+        # in sequence, so the first divergent op trips here); the
+        # rolling hash is carried as context only — judging on it too
+        # would poison every later, agreeing trace after a detected
+        # divergence.
+        if all(v[1] == views[0][1] for v in views):
+            return
+        lines = [
+            f"  rank {i}: {'DIVERGED ' if v[1] != views[0][1] else ''}"
+            f"{v[1][0]} instance_key={v[1][1]} group_key={v[1][2]} "
+            f"dtype={v[1][3]} shape={v[1][4]} name={v[1][5]} "
+            f"history={v[0][:12]}" for i, v in enumerate(views)]
+        raise RuntimeError(
+            "rank-divergent tf.function tracing detected at traced "
+            f"collective #{seq} (this rank: {kind} of {name or rec}) "
+            "— ranks are emitting different collective sequences, "
+            "which would deadlock at execution time. Make traced "
+            "control flow identical across ranks (no rank-dependent "
+            "conditionals around hvd ops).\n" + "\n".join(lines))
+
 
 _ctx = _GraphCollectives()
 
@@ -254,9 +321,12 @@ def allreduce_graph(tensor, op, prescale_factor, postscale_factor,
     if group_size == 1:
         return _scaled(tensor, postscale_factor)
     merge_op, final_op = _MERGE_FINAL[op]
+    ikey = _ctx.next_instance_key()
+    _ctx.key_check("allreduce", ikey, group_key, tensor.dtype,
+                   tensor.shape, getattr(tensor, "name", None))
     out = tf.raw_ops.CollectiveReduceV2(
         input=tensor, group_size=group_size, group_key=group_key,
-        instance_key=_ctx.next_instance_key(), ordering_token=[],
+        instance_key=ikey, ordering_token=[],
         merge_op=merge_op, final_op=final_op,
         communication_hint="ring", timeout_seconds=_ctx.timeout)
     return _scaled(out, postscale_factor)
@@ -272,9 +342,12 @@ def allgather_graph(tensor, process_set):
     group_key, group_size = _ctx.group(process_set)
     if group_size == 1:
         return tf.identity(tensor)
+    ikey = _ctx.next_instance_key()
+    _ctx.key_check("allgather", ikey, group_key, tensor.dtype,
+                   tensor.shape, getattr(tensor, "name", None))
     return tf.raw_ops.CollectiveGatherV2(
         input=tensor, group_size=group_size, group_key=group_key,
-        instance_key=_ctx.next_instance_key(), ordering_token=[],
+        instance_key=ikey, ordering_token=[],
         communication_hint="ring", timeout_seconds=_ctx.timeout)
 
 
@@ -282,8 +355,11 @@ def broadcast_graph(tensor, root_rank, process_set):
     group_key, group_size = _ctx.group(process_set)
     if group_size == 1:
         return tf.identity(tensor)
+    ikey = _ctx.next_instance_key()
+    _ctx.key_check("broadcast", ikey, group_key, tensor.dtype,
+                   tensor.shape, getattr(tensor, "name", None))
     kwargs = dict(group_size=group_size, group_key=group_key,
-                  instance_key=_ctx.next_instance_key(),
+                  instance_key=ikey,
                   communication_hint="ring",
                   timeout_seconds=_ctx.timeout)
     if basics.rank() == root_rank:
@@ -299,8 +375,11 @@ def reducescatter_graph(tensor, op, process_set):
     if group_size == 1:
         return tf.identity(tensor)
     merge_op, final_op = _MERGE_FINAL[op]
+    ikey = _ctx.next_instance_key()
+    _ctx.key_check("reducescatter", ikey, group_key, tensor.dtype,
+                   tensor.shape, getattr(tensor, "name", None))
     return tf.raw_ops.CollectiveReduceScatterV2(
         input=tensor, group_size=group_size, group_key=group_key,
-        instance_key=_ctx.next_instance_key(), ordering_token=[],
+        instance_key=ikey, ordering_token=[],
         merge_op=merge_op, final_op=final_op,
         communication_hint="ring", timeout_seconds=_ctx.timeout)

@@ -52,10 +52,13 @@ def free_ports(n: int) -> List[int]:
 
 
 def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
-                extra_env: Optional[dict] = None
-                ) -> List[Tuple[int, str]]:
+                extra_env: Optional[dict] = None,
+                per_rank_env=None) -> List[Tuple[int, str]]:
     """Run ``body`` (dedented python source, sees RANK/SIZE/np/hvd/jax)
     in ``nproc`` worker processes.  Returns [(returncode, output)].
+
+    ``per_rank_env(rank) -> dict`` overrides the env contract per rank
+    (e.g. to simulate a two-tier host topology on localhost).
     """
     coord_port, ctrl_port = free_ports(2)
     code = _PRELUDE + textwrap.dedent(body)
@@ -74,9 +77,14 @@ def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
             "HOROVOD_TPU_FORCE_CPU": "1",
             "PYTHONPATH": REPO,
         })
-        if extra_env:
-            env.update(extra_env)
-        if not (extra_env and "XLA_FLAGS" in extra_env):
+        supplied = dict(extra_env or {})
+        if per_rank_env:
+            supplied.update({k: str(v)
+                             for k, v in per_rank_env(rank).items()})
+        env.update(supplied)
+        # Workers default to 1 CPU device: scrub the conftest's
+        # 8-device XLA_FLAGS unless the test supplied its own.
+        if "XLA_FLAGS" not in supplied:
             env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code], env=env,

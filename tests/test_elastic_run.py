@@ -241,3 +241,136 @@ def test_elastic_worker_death_shrinks_world(tmp_path):
     assert m and int(m.group(1)) >= 3, logs[-2000:]
     # The dead slot's non-zero code is recorded, not fatal.
     assert any(c != 0 for c in result["codes"].values()), result
+
+
+TWO_TIER_WORKER = """
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+import horovod_tpu.jax as hj
+from horovod_tpu.common import basics
+from horovod_tpu.jax.elastic import JaxState, run
+
+hvd.init()
+state = JaxState(epoch=0)
+STOP = os.environ["TEST_STOP_FILE"]
+DOOMED = os.environ["HOROVOD_HOSTNAME"] == os.environ["TEST_DOOMED_HOST"]
+
+@run
+def train(state):
+    while not os.path.exists(STOP):
+        if DOOMED and state.epoch >= 2:
+            print("DYING", flush=True)
+            os._exit(1)
+        val = np.asarray(hj.allreduce(
+            np.ones(2, np.float32), op=hvd.Sum,
+            name=f"t{state.epoch}"))
+        assert val[0] == hvd.size(), (val, hvd.size())
+        # The two-tier contract must hold at the CURRENT world:
+        # rank = cross_rank * local_size + local_rank, and when
+        # local_size > 1 the hierarchical proc mesh must re-form.
+        ri = basics._state().rank_info
+        assert ri.rank == ri.cross_rank * ri.local_size + \
+            ri.local_rank, vars(ri)
+        be = basics._state().backend
+        hier = getattr(be, "fallback", be)
+        if ri.local_size > 1 and ri.size > 1:
+            assert hier._hier_kind == "proc", hier._hier_kind
+            assert hier._hier.devices.shape == \
+                (ri.cross_size, ri.local_size)
+        print(f"EPOCH {state.epoch} rank={hvd.rank()} "
+              f"size={hvd.size()} lr={ri.local_rank} "
+              f"ls={ri.local_size} cr={ri.cross_rank} "
+              f"cs={ri.cross_size}", flush=True)
+        state.epoch += 1
+        state.commit()
+        time.sleep(0.05)
+    return state.epoch
+
+train(state)
+print(f"DONE rank={hvd.rank()} epoch={state.epoch} "
+      f"size={hvd.size()}", flush=True)
+"""
+
+
+def test_elastic_two_tier_host_loss(tmp_path):
+    """VERDICT r3 item 6 (elastic leg): a 2-host x 2-slot world loses
+    a whole 'host' mid-run; survivors re-rendezvous as 1 host x 2
+    slots with the local/cross contract recomputed (cross_size 2 -> 1)
+    and the hierarchical mesh re-formed over the new topology."""
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner.elastic_run import launch_elastic
+
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:2\n127.0.0.1:2\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+    stop_file = tmp_path / "stop"
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(TWO_TIER_WORKER)
+    outdir = tmp_path / "out"
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    result = {}
+
+    def run_launcher():
+        try:
+            result["codes"] = launch_elastic(
+                [sys.executable, str(worker_py)],
+                discovery=HostDiscoveryScript(str(script), 1),
+                np=4, min_np=2, max_np=4,
+                elastic_timeout=60,
+                output_filename=str(outdir),
+                env=env,
+                extra_worker_env={
+                    "HOROVOD_TPU_FORCE_CPU": "1",
+                    "HOROVOD_CPU_OPERATIONS": "XLA",
+                    # One virtual device per worker: the host tier is
+                    # simulated by PROCESS groups, so the conftest's
+                    # 8-device XLA_FLAGS must not leak in (it would
+                    # flip the hierarchy to device-kind).
+                    "XLA_FLAGS":
+                        "--xla_force_host_platform_device_count=1",
+                    "TEST_STOP_FILE": str(stop_file),
+                    "TEST_DOOMED_HOST": "127.0.0.1",
+                    "HOROVOD_START_TIMEOUT": "90",
+                })
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=run_launcher, daemon=True)
+    t.start()
+
+    def wait_for(pattern, timeout=180):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if re.search(pattern, _scan_logs(outdir)):
+                return
+            if not t.is_alive():
+                raise AssertionError(
+                    f"launcher exited early: {result}\n"
+                    f"logs:\n{_scan_logs(outdir)[-3000:]}")
+            time.sleep(0.5)
+        raise AssertionError(
+            f"pattern {pattern!r} never appeared; logs:\n"
+            f"{_scan_logs(outdir)[-3000:]}")
+
+    # Phase 1: 4 workers, two-tier (cross_size=2, local_size=2).
+    wait_for(r"EPOCH \d+ rank=\d size=4 lr=\d ls=2 cr=\d cs=2")
+    wait_for(r"DYING")
+    # Phase 2: the dead host's pair is blacklisted; the surviving host
+    # re-forms as one tier (size 2, cross_size 1) from committed state.
+    wait_for(r"EPOCH [2-9]\d* rank=\d size=2 lr=\d ls=2 cr=0 cs=1")
+    # Phase 3: stop; survivors exit cleanly.
+    stop_file.write_text("")
+    t.join(timeout=120)
+    assert not t.is_alive(), "launcher did not finish"
+    assert "error" not in result, result.get("error")
+    logs = _scan_logs(outdir)
+    assert len(re.findall(r"DONE rank=\d epoch=\d+ size=2", logs)) == 2
+    assert any(c != 0 for c in result["codes"].values()), result

@@ -1,0 +1,94 @@
+"""Two-tier (DCN x ICI) topology rehearsal on localhost.
+
+VERDICT r3 item 6: simulate 2 "hosts" x 2 "chips" through the env
+contract (distinct HOROVOD_LOCAL_RANK/CROSS_RANK per rank), and prove
+the hierarchical allreduce really splits local-RS -> cross-AR ->
+local-AG on the right tiers — the test FAILS if the cross leg is
+silently flat (numeric check), on the wrong tier (jaxpr axis check),
+or if the hierarchical path wasn't taken at all (stats check).
+Reference: ops/nccl_operations.cc:188-360 NCCLHierarchicalAllreduce
+(NCCL reduce-scatter intra-node -> MPI allreduce cross-node -> NCCL
+allgather).
+"""
+
+import pytest
+
+from multiproc import assert_all_ok, run_workers
+
+NPROC = 4
+LOCAL = 2  # chips per simulated host
+
+
+def two_tier_env(rank):
+    return {
+        "HOROVOD_LOCAL_RANK": rank % LOCAL,
+        "HOROVOD_LOCAL_SIZE": LOCAL,
+        "HOROVOD_CROSS_RANK": rank // LOCAL,
+        "HOROVOD_CROSS_SIZE": NPROC // LOCAL,
+    }
+
+
+_HIER_BODY = """
+import horovod_tpu as hvd
+hvd.init()
+from horovod_tpu.common import basics
+be = basics._state().backend
+assert type(be).__name__ == "XlaMeshBackend", type(be)
+
+# The env contract produced the two-tier process mesh.
+assert be._hier is not None and be._hier_kind == "proc", \
+    (be._hier_kind, be._hier)
+assert be._hier_nlocal == 2
+grid = be._hier.devices
+assert grid.shape == (2, 2)
+# Rows = cross index = simulated host; each row's devices must belong
+# to the two ranks of ONE host, each column spans both hosts.
+for c in range(2):
+    row_procs = sorted(d.process_index for d in grid[c])
+    assert row_procs == [2 * c, 2 * c + 1], (c, row_procs)
+
+# Numeric: result must be the GLOBAL sum — if the cross-AR leg were
+# dropped (a silently flat hierarchy), each host would only see its
+# local pair's sum and this fails.
+x = np.arange(6, dtype=np.float32) + 100.0 * RANK
+out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="tt.ar"))
+exp = sum(np.arange(6, dtype=np.float32) + 100.0 * r
+          for r in range(SIZE))
+np.testing.assert_allclose(out, exp)
+
+# The hierarchical path was actually taken (not the flat fallback).
+assert be.stats.get("hierarchical_allreduces", 0) >= 1, be.stats
+assert be.stats.get("flat_allreduces", 0) == 0, be.stats
+
+# Tier structure: trace the PRODUCT hierarchical program and assert
+# the op sequence and the axis each leg runs on — reduce-scatter over
+# 'local', allreduce over 'cross', allgather over 'local'.
+import jax, re
+fn = type(be)._hier_proc_fn(be._hier, ((6,),), "Sum", 1.0, 1.0, SIZE)
+from jax.sharding import NamedSharding, PartitionSpec as P
+spec = jax.ShapeDtypeStruct(
+    (2, 2, 6), np.float32,
+    sharding=NamedSharding(be._hier, P("cross", "local")))
+jaxpr = str(jax.make_jaxpr(fn)(spec))
+rs = re.search(r"reduce_scatter\\[[^]]*axis_name=\\('(\\w+)',\\)",
+               jaxpr)
+ar = re.search(r"\\bpsum\\[[^]]*axes=\\('(\\w+)',\\)", jaxpr)
+ag = re.search(r"all_gather\\[[^]]*axis_name=\\('(\\w+)',\\)", jaxpr)
+assert rs and ar and ag, jaxpr
+assert rs.group(1) == "local", jaxpr
+assert ar.group(1) == "cross", jaxpr
+assert ag.group(1) == "local", jaxpr
+assert rs.start() < ar.start() < ag.start(), \
+    (rs.start(), ar.start(), ag.start())
+print("TWO-TIER-OK")
+"""
+
+
+def test_hierarchical_allreduce_two_tier():
+    results = run_workers(
+        _HIER_BODY, nproc=NPROC, timeout=300,
+        extra_env={"HOROVOD_CPU_OPERATIONS": "XLA",
+                   "HOROVOD_HIERARCHICAL_ALLREDUCE": "1"},
+        per_rank_env=two_tier_env)
+    assert_all_ok(results)
+    assert all("TWO-TIER-OK" in out for _, out in results)
