@@ -270,10 +270,29 @@ for mb in sizes_mb:
             "size_mb": mb, "input": kind, "iters": iters,
             "gbps": round(mb / 1024 * iters / dt, 3),
         })
+# Control-plane latency floor: a 1-element allreduce and a barrier
+# time the pure submit->CH->CB->dispatch->callback round (no data).
+tiny = np.ones(1, np.float32)
+for _ in range(5):
+    hvd.allreduce(tiny, op=hvd.Sum, name="bench.tiny")
+t0 = time.perf_counter()
+for _ in range(100):
+    hvd.allreduce(tiny, op=hvd.Sum, name="bench.tiny")
+tiny_ms = (time.perf_counter() - t0) / 100 * 1e3
+for _ in range(5):
+    hvd.barrier()
+t0 = time.perf_counter()
+for _ in range(100):
+    hvd.barrier()
+barrier_ms = (time.perf_counter() - t0) / 100 * 1e3
+
 from horovod_tpu.common import basics
 stats = dict(basics._state().runtime.controller.stats)
 if RANK == 0:
-    print("BENCHJSON " + json.dumps({"results": results, "frames": stats}))
+    print("BENCHJSON " + json.dumps({
+        "results": results, "frames": stats,
+        "control_floor": {"tiny_allreduce_ms": round(tiny_ms, 3),
+                          "barrier_ms": round(barrier_ms, 3)}}))
 hvd.shutdown()
 """
 

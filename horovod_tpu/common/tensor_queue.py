@@ -63,6 +63,27 @@ class TensorQueue:
                 self._table[tkey] = e
                 self._pending.append(r)
 
+    def add_entry_only(self, entry: TensorTableEntry):
+        """Table insert without queueing the request — the inline
+        cache-hit path sends its own CH frame from the caller thread,
+        so the entry must be resolvable by the dispatch thread but the
+        request must never reach the negotiation queue."""
+        with self._lock:
+            tkey = f"{entry.process_set_id}:{entry.tensor_name}"
+            if tkey in self._table:
+                raise DuplicateTensorNameError(
+                    f"Duplicate tensor name {entry.tensor_name!r} "
+                    "submitted; a previous collective with this name "
+                    "has not completed. This usually means ranks are "
+                    "running different graphs.")
+            self._table[tkey] = entry
+
+    def queue_request(self, request: Request):
+        """Queue a request whose entry is already in the table (the
+        inline path falling back to negotiation on a cache miss)."""
+        with self._lock:
+            self._pending.append(request)
+
     def pop_pending(self) -> List[Request]:
         """Drain the pending-request queue (one negotiation cycle's worth)."""
         with self._lock:

@@ -52,6 +52,22 @@ except ImportError:
 
 _OPS = {"Sum": 0, "Average": 0, "Product": 1, "Min": 2, "Max": 3}
 
+# XLA's CPU client zero-copies host buffers only at this alignment;
+# anything less costs a copy (plus a fence under a distributed client).
+_XLA_ALIGN = 128
+
+
+def _aligned_empty(shape, dtype, align=_XLA_ALIGN) -> np.ndarray:
+    """Fresh C-contiguous array whose data pointer is ``align``-ed, so
+    jax can adopt it zero-copy (see _rewrap)."""
+    dt = np.dtype(dtype)
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),)
+    n = int(np.prod(shape, initial=1))
+    raw = np.empty(n * dt.itemsize + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + n * dt.itemsize].view(dt).reshape(shape)
+
 
 def _bind(lib):
     lib.hvd_ring_create.restype = ctypes.c_void_p
@@ -286,9 +302,45 @@ class RingBackend(Backend):
             self._fusion_bufs[dtype.str] = buf
         return buf[:n]
 
+    # Above this, fresh-alloc page faults outweigh the saved staging
+    # copy and the persistent fusion buffer wins (see _fused()).
+    ONE_COPY_MAX_BYTES = 4 << 20
+
+    def _allreduce_single_fast(self, a, reduce_op, prescale, postscale):
+        """Small single-tensor fast path: ONE fresh output copy, ring
+        runs in place on it — skips the fusion-buffer double copy
+        (~0.2 ms at 1 MB) and the generic multi-tensor bookkeeping.
+        Returns None when ineligible (caller takes the general path)."""
+        was_jax = self._is_jax(a)
+        src = np.asarray(a)
+        dt = src.dtype
+        if dt not in _DTYPES or src.nbytes > self.ONE_COPY_MAX_BYTES:
+            return None
+        out = _aligned_empty(src.shape, dt)  # fresh working copy
+        np.copyto(out, src)
+        flat = out.reshape(-1)
+        self._scale_inplace(flat, prescale)
+        if flat.size:
+            with self._fusion_lock:      # one collective on the ring
+                rc = self._lib.hvd_ring_allreduce(
+                    self._comm, out.ctypes.data_as(ctypes.c_void_p),
+                    flat.size, _DTYPES[dt], _OPS[reduce_op], None, 0)
+            if rc != 0:
+                raise RuntimeError(f"ring allreduce failed (rc={rc})")
+        post = postscale / self.size if reduce_op == "Average" \
+            else postscale
+        self._scale_inplace(flat, post)
+        return [self._rewrap(out, was_jax)]
+
     # -- allreduce -------------------------------------------------------
     def allreduce(self, arrays, reduce_op, prescale, postscale,
                   ps_ranks=()):
+        if len(arrays) == 1 and not ps_ranks and reduce_op in _OPS:
+            fast = self._allreduce_single_fast(
+                arrays[0], reduce_op, prescale, postscale)
+            if fast is not None:
+                self.stats["ring_allreduces"] += 1
+                return fast
         dt = np.result_type(*(np.asarray(a).dtype for a in arrays)) \
             if arrays else np.float32
         if reduce_op not in _OPS or \
@@ -335,7 +387,7 @@ class RingBackend(Backend):
             self._scale_inplace(buf, post)
             out, off = [], 0
             for a, odt, wj in zip(nps, orig_dtypes, was_jax):
-                piece = np.empty(a.shape, odt)
+                piece = _aligned_empty(a.shape, odt)
                 np.copyto(piece,
                           buf[off:off + a.size].reshape(a.shape),
                           casting="unsafe")
@@ -352,6 +404,18 @@ class RingBackend(Backend):
     def _rewrap(x: np.ndarray, was_jax: bool):
         if not was_jax:
             return x
+        # Zero-copy wrap when the buffer is XLA-aligned: jax's CPU
+        # client copies (and under a distributed gloo client, fences)
+        # unaligned numpy inputs — measured 0.32 ms vs 0.03 ms at 1 MB
+        # on the bench rig.  Outputs from _aligned_empty always take
+        # the fast branch; x is a fresh per-call array we never touch
+        # again, so aliasing its memory into the jax Array is safe.
+        if x.ctypes.data % _XLA_ALIGN == 0 and x.flags.c_contiguous:
+            try:
+                import jax.dlpack
+                return jax.dlpack.from_dlpack(x)
+            except Exception:
+                pass
         import jax.numpy as jnp
         return jnp.asarray(x)
 
@@ -375,7 +439,7 @@ class RingBackend(Backend):
             counts = (ctypes.c_longlong * gsize)(
                 *[int(t) * row_bytes for t in tsizes])
             total_rows = int(sum(tsizes))
-            res = np.empty((total_rows,) + a.shape[1:], a.dtype)
+            res = _aligned_empty((total_rows,) + a.shape[1:], a.dtype)
             rc = self._lib.hvd_ring_allgather(
                 self._comm, a.ctypes.data_as(ctypes.c_void_p),
                 a.nbytes, res.ctypes.data_as(ctypes.c_void_p),
@@ -452,7 +516,8 @@ class RingBackend(Backend):
             *[int(s) * row_bytes for s in splits])
         recvcounts = (ctypes.c_longlong * gsize)(
             *[int(s) * row_bytes for s in recv_splits])
-        out = np.empty((int(recv_splits.sum()),) + a.shape[1:], a.dtype)
+        out = _aligned_empty((int(recv_splits.sum()),) + a.shape[1:],
+                     a.dtype)
         rc = self._lib.hvd_ring_alltoall(
             self._comm, a.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p), sendcounts, recvcounts,
@@ -528,7 +593,7 @@ class RingBackend(Backend):
             for j, (i, a, wj) in enumerate(items):
                 myrows = rowcounts[j][my_idx]
                 nel = myrows * rowelems[j]
-                piece = np.empty((myrows,) + a.shape[1:], a.dtype)
+                piece = _aligned_empty((myrows,) + a.shape[1:], a.dtype)
                 np.copyto(piece, res[o:o + nel].reshape(piece.shape),
                           casting="unsafe")
                 o += nel

@@ -129,7 +129,8 @@ class KerasEstimator(HorovodEstimator):
             # allreduce would otherwise desync on unequal shards and
             # hang the larger ranks at end of fit.
             steps_per_epoch = util.sync_steps_per_epoch(
-                meta, "train", size, batch_size)
+                meta, "train", size, batch_size,
+                store=store, col=feature_cols[0])
             nfeat = len(feature_cols)
 
             def epoch_pass(e, drop):
@@ -152,9 +153,16 @@ class KerasEstimator(HorovodEstimator):
                         f"promised {my_rows} rows)")
 
             def gen():
+                import itertools
+                # Truncate each pass to the SYNCED step count: a rank
+                # with surplus batches would otherwise spill them into
+                # keras's next epoch, drifting epoch boundaries (and
+                # the per-epoch reshuffle seed / checkpoint) further
+                # every epoch.
                 e = start_epoch
                 while True:
-                    yield from epoch_pass(e, True)
+                    yield from itertools.islice(
+                        epoch_pass(e, True), steps_per_epoch)
                     e += 1
 
             cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]

@@ -87,7 +87,8 @@ class TorchEstimator(HorovodEstimator):
             # allreduce would otherwise desync on unequal shards and
             # hang the larger ranks at epoch end.
             max_steps = util.sync_steps_per_epoch(
-                meta, "train", size, batch_size, ceil=True)
+                meta, "train", size, batch_size, ceil=True,
+                store=store, col=feature_cols[0])
 
             history = []
             for epoch in range(start_epoch, epochs):

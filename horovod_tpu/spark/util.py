@@ -173,8 +173,8 @@ def stream_batches(store, split: str, rank: int, size: int,
 def shard_rows(meta: Dict, split: str, rank: int, size: int) -> int:
     """Rows this rank will stream for ``split``, from metadata alone.
     Metadata written before per-part counts existed falls back to an
-    even distribution of the split total (never rounding a nonempty
-    split down to 0 rows for low ranks)."""
+    even distribution of the split total (an ESTIMATE — use
+    :func:`part_row_counts` when exactness matters)."""
     part_rows = meta.get(f"{split}_part_rows")
     if part_rows is not None:
         return int(sum(part_rows[rank::size]))
@@ -183,15 +183,50 @@ def shard_rows(meta: Dict, split: str, rank: int, size: int) -> int:
     return base + (1 if rank < rem else 0)
 
 
+def part_row_counts(store, split: str, col: str) -> List[int]:
+    """Exact per-part row counts read from the npz member HEADERS (a
+    few hundred bytes per part, no data) — the recovery path for
+    legacy metadata that predates ``<split>_part_rows``."""
+    import zipfile
+    from numpy.lib import format as npf
+
+    path = (store.get_train_data_path() if split == "train"
+            else store.get_val_data_path())
+    counts = []
+    for p in sorted(store.list(path, "part-*.npz")):
+        with store.open_read(p) as f, zipfile.ZipFile(f) as zf:
+            with zf.open(col + ".npy") as m:
+                version = npf.read_magic(m)
+                if version >= (2, 0):
+                    shape, _, _ = npf.read_array_header_2_0(m)
+                else:
+                    shape, _, _ = npf.read_array_header_1_0(m)
+        counts.append(int(shape[0]) if shape else 1)
+    return counts
+
+
 def sync_steps_per_epoch(meta: Dict, split: str, size: int,
-                         batch_size: int, ceil: bool = False) -> int:
+                         batch_size: int, ceil: bool = False,
+                         store=None, col: Optional[str] = None) -> int:
     """Per-epoch step count EVERY rank can run: the minimum over
     ranks' shard sizes.  Synchronous DP allreduces once per batch, so
     a rank running extra steps would block forever in a collective its
     peers never join (reference: the coordinator only fires a tensor
     once all ranks submit it, controller.cc IncrementTensorCount).
-    Raises if any rank would stream nothing at all."""
-    rows = [shard_rows(meta, split, r, size) for r in range(size)]
+
+    Row counts come from the metadata's per-part table; for legacy
+    metadata without one, pass ``store``+``col`` so the EXACT counts
+    are read from shard headers — the even-split estimate must never
+    size a synchronized step count (a rank whose true shard is
+    smaller than the estimate would still desync).  Raises if any
+    rank would stream nothing at all."""
+    part_rows = meta.get(f"{split}_part_rows")
+    if part_rows is None and store is not None and col is not None:
+        part_rows = part_row_counts(store, split, col)
+    if part_rows is not None:
+        rows = [int(sum(part_rows[r::size])) for r in range(size)]
+    else:
+        rows = [shard_rows(meta, split, r, size) for r in range(size)]
     if min(rows) == 0:
         empty = [r for r, n in enumerate(rows) if n == 0]
         raise ValueError(

@@ -132,6 +132,31 @@ def test_stream_batches_bounded_residency(tmp_path):
             sutil.shard_rows(meta, "train", rank, 2)
 
 
+def test_sync_steps_exact_on_legacy_metadata(tmp_path):
+    """Legacy metadata (no per-part rows) must NOT size synchronized
+    steps from the even-split estimate — a rank whose true part
+    assignment is smaller would desync the per-batch allreduce.  With
+    store+col, exact counts come from npz headers (no data read)."""
+    store = FilesystemStore(str(tmp_path))
+    meta = sutil.prepare_data(4, store, _df(103), feature_cols=["x"],
+                              label_cols=["y"])
+    # Exact header-read counts match the metadata table.
+    assert sutil.part_row_counts(store, "train", "x") == \
+        meta["train_part_rows"]
+    legacy = {k: v for k, v in meta.items()
+              if k != "train_part_rows"}
+    exact = sutil.sync_steps_per_epoch(meta, "train", 2, 10)
+    recovered = sutil.sync_steps_per_epoch(legacy, "train", 2, 10,
+                                           store=store, col="x")
+    assert recovered == exact
+    # Every rank can actually stream that many full batches.
+    for rank in range(2):
+        got = list(sutil.stream_batches(store, "train", rank, 2,
+                                        ["x", "y"], 10,
+                                        drop_remainder=True))
+        assert len(got) >= exact
+
+
 def test_stream_batches_epoch_reshuffle(tmp_path):
     store = FilesystemStore(str(tmp_path))
     sutil.prepare_data(4, store, _df(64), feature_cols=["x"],
