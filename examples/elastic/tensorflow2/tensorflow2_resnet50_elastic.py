@@ -37,9 +37,24 @@ parser.add_argument("--num-warmup-batches", type=int, default=10)
 parser.add_argument("--num-batches-per-iter", type=int, default=10)
 parser.add_argument("--num-iters", type=int, default=10)
 parser.add_argument("--num-batches-per-commit", type=int, default=1)
+parser.add_argument("--in-graph", action="store_true",
+                    help="keep collectives inside the traced graph "
+                         "across resizes (sets "
+                         "HOROVOD_TF_ELASTIC_GRAPH=1; the TF context "
+                         "is reset on every resize and the model is "
+                         "rebuilt in on_reset)")
 args = parser.parse_args()
 
+if args.in_graph:
+    # The knob is read dynamically by the graph-collective layer, so
+    # setting it after import (from this CLI flag) is fine.
+    import os
+    os.environ.setdefault("HOROVOD_TF_ELASTIC_GRAPH", "1")
+
 hvd.init()
+if args.in_graph:
+    assert hvd.enable_graph_collectives(), \
+        "graph collectives failed to enable (call before any TF op)"
 
 lr = 0.01
 
@@ -57,28 +72,50 @@ def build_model():
         classes=1000)
 
 
-model = build_model()
-opt = tf.optimizers.SGD(lr * hvd.size())
 num_classes = 10 if args.model == "simple" else 1000
-
-data = tf.random.uniform([args.batch_size, args.image_size,
-                          args.image_size, 3])
-target = tf.random.uniform([args.batch_size, 1], minval=0,
-                           maxval=num_classes, dtype=tf.int64)
-
 compression = (hvd.Compression.fp16 if args.fp16_allreduce
                else hvd.Compression.none)
 
 
-@tf.function
-def train_one_batch():
-    with tf.GradientTape() as tape:
-        logits = model(data, training=True)
-        loss = tf.losses.sparse_categorical_crossentropy(
-            target, logits, from_logits=True)
-    tape = hvd.DistributedGradientTape(tape, compression=compression)
-    gradients = tape.gradient(loss, model.trainable_variables)
-    opt.apply_gradients(zip(gradients, model.trainable_variables))
+def build_training():
+    """Model + optimizer + traced step + data, rebuildable: with
+    --in-graph, every elastic resize resets the TF context, so all of
+    these are re-created in on_state_reset."""
+    model = build_model()
+    opt = tf.optimizers.SGD(lr * hvd.size())
+    data = tf.random.uniform([args.batch_size, args.image_size,
+                              args.image_size, 3])
+    target = tf.random.uniform([args.batch_size, 1], minval=0,
+                               maxval=num_classes, dtype=tf.int64)
+
+    @tf.function
+    def train_one_batch():
+        with tf.GradientTape() as tape:
+            logits = model(data, training=True)
+            loss = tf.losses.sparse_categorical_crossentropy(
+                target, logits, from_logits=True)
+        tape = hvd.DistributedGradientTape(tape,
+                                           compression=compression)
+        gradients = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(gradients, model.trainable_variables))
+    return model, opt, train_one_batch
+
+
+model, opt, train_one_batch = build_training()
+
+
+def collective_path():
+    """Name the plane the traced step actually uses (for the log)."""
+    try:
+        cf = train_one_batch.get_concrete_function()
+        ops = {op.type for op in cf.graph.get_operations()}
+    except Exception:
+        return "untraced"
+    if any("PyFunc" in t for t in ops):
+        return "py_function"
+    if "CollectiveReduceV2" in ops:
+        return "collective_v2"
+    return "local"
 
 
 def benchmark_step(state):
@@ -116,16 +153,26 @@ def run_benchmark(state):
         dt = timeit.timeit(lambda: benchmark_step(state),
                            number=args.num_batches_per_iter)
         img_sec = args.batch_size * args.num_batches_per_iter / dt
-        log(f"Iter #{x}: {img_sec:.1f} img/sec per worker")
+        log(f"Iter #{x}: {img_sec:.1f} img/sec per worker "
+            f"(size={hvd.size()}, path={collective_path()})")
         state.img_secs.append(img_sec)
         state.iter = x
         state.commit()
 
 
 def on_state_reset():
+    global model, opt, train_one_batch
+    if args.in_graph:
+        # The resize reset the TF context: rebuild everything and
+        # re-point the state at the fresh objects (weights restore
+        # from the last committed numpy snapshot).
+        model, opt, train_one_batch = build_training()
+        train_one_batch()
+        state.rebuild(model, opt)
     # World size changed: rescale the learning rate (reference
     # example's on_state_reset).
     opt.learning_rate.assign(lr * hvd.size())
+    log(f"reset: size={hvd.size()} path={collective_path()}")
 
 
 state = hvd.elastic.TensorFlowKerasState(

@@ -243,38 +243,67 @@ def init(comm=None, process_sets=None):
                      state.rank_info.local_rank, state.rank_info.local_size)
 
 
+def _teardown_jax_distributed():
+    """Tear down the jax.distributed client so a later init() can
+    re-form the world with a different size (elastic reset; verified
+    working on the gloo CPU path and on TPU via the
+    coordination-service client restart)."""
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        logger.warning("jax.distributed.shutdown failed",
+                       exc_info=True)
+    try:
+        jax.clear_caches()
+        import jax.extend.backend as _jeb
+        _jeb.clear_backends()
+    except Exception:
+        logger.warning("clearing XLA backends failed", exc_info=True)
+
+
 def shutdown():
     state = _state()
     with state.init_lock:
         if not state.initialized:
             return
         if state.runtime is not None:
-            state.runtime.stop()
-            state.runtime = None
+            # Quiesce (not detach): halts the cycle loop AND disables
+            # recv-thread response dispatch before the backend closes,
+            # so a late frame can't execute against a freed ring
+            # communicator; the controller attachment itself stays up
+            # as the teardown-ordering signal (below).
+            state.runtime.quiesce()
         if state.timeline is not None:
             state.timeline.close()
             state.timeline = None
         if state.backend is not None and hasattr(state.backend, "close"):
             state.backend.close()
         state.backend = None
+        # Teardown ORDER is load-bearing for elastic resets: the jax
+        # coordination service (hosted by rank 0) dying under a
+        # still-attached client is PROCESS-FATAL for that client
+        # (LOG(FATAL) in the disconnect RPC — recoverability does not
+        # cover leader loss).  So in elastic mode non-leader ranks
+        # disconnect their jax client FIRST, while still attached to
+        # the rank-0 controller; rank 0's controller shutdown
+        # drain-waits on those attachments, and only then takes the
+        # coordination service down.  Elastic-only: recoverable tasks
+        # skip jax's client-side shutdown barrier, so the early
+        # disconnect returns immediately — in non-elastic mode it
+        # would block on the barrier against rank 0, which is itself
+        # waiting in the controller drain (a deadlock ridden out by
+        # timeouts).
+        is_leader = state.rank_info.rank == 0
+        if state.distributed_client_owned and not is_leader and \
+                state.knobs.elastic:
+            _teardown_jax_distributed()
+            state.distributed_client_owned = False
+        if state.runtime is not None:
+            state.runtime.detach()
+            state.runtime = None
         if state.distributed_client_owned:
-            # Tear down the jax.distributed client so a later init()
-            # can re-form the world with a different size (elastic
-            # reset; verified working on the gloo CPU path and on TPU
-            # via the coordination-service client restart).
-            import jax
-            try:
-                jax.distributed.shutdown()
-            except Exception:
-                logger.warning("jax.distributed.shutdown failed",
-                               exc_info=True)
-            try:
-                jax.clear_caches()
-                import jax.extend.backend as _jeb
-                _jeb.clear_backends()
-            except Exception:
-                logger.warning("clearing XLA backends failed",
-                               exc_info=True)
+            _teardown_jax_distributed()
             state.distributed_client_owned = False
         state.initialized = False
 

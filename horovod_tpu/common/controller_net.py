@@ -51,6 +51,7 @@ _MAGIC_HITS = b"CH"     # worker→coord: cache-hit bit list (fast path)
 _MAGIC_CACHE = b"CB"    # coord→worker: fused batches of cache bits
 _MAGIC_EVICT = b"EV"    # coord→worker: evicted cache bits
 _MAGIC_PARAMS = b"PA"   # coord→worker: autotuned runtime parameters
+_MAGIC_ABORT = b"AB"    # coord→worker: membership broken, fail fast
 
 
 def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
@@ -268,6 +269,14 @@ class CoordinatorServer:
                 error_message=msg) for name in pending]
             if responses:
                 self._broadcast_locked(responses)
+            # Abort broadcast: a worker with NO pending eager
+            # negotiation (e.g. blocked inside a TF in-graph
+            # collective, or compute-bound) must still learn the
+            # membership broke NOW — while this coordinator is alive —
+            # so it can unwind and disconnect its jax client before
+            # rank 0 takes the coordination service down (leader loss
+            # under an attached client is process-fatal).
+            self._broadcast_frame_locked(_MAGIC_ABORT, msg.encode())
 
     def _broadcast_locked(self, responses: List[Response]):
         self._broadcast_frame_locked(_MAGIC_RESP,
@@ -838,6 +847,21 @@ class NetworkController(Controller):
         raise ConnectionError(
             f"could not reach coordinator at {self._addr}: {last_err}")
 
+    def set_broken_callback(self, fn):
+        """Called once (from the recv thread) when the control-plane
+        connection dies mid-incarnation, so the runtime can fail fast
+        instead of waiting for the next submission to notice."""
+        self._on_broken = fn
+
+    def _set_broken(self, err):
+        self._broken_err = err
+        cb = getattr(self, "_on_broken", None)
+        if cb is not None:
+            try:
+                cb(err)
+            except Exception:
+                logger.warning("broken-callback failed", exc_info=True)
+
     def _recv_loop(self):
         while True:
             try:
@@ -847,9 +871,9 @@ class NetworkController(Controller):
             if frame is None:
                 if not self._closing:
                     from .exceptions import HorovodInternalError
-                    self._broken_err = HorovodInternalError(
+                    self._set_broken(HorovodInternalError(
                         "connection to the coordinator was lost "
-                        "(membership changed or rank 0 exited)")
+                        "(membership changed or rank 0 exited)"))
                 return
             magic, payload = frame
             self.stats["bytes_recv"] += len(payload) + 6
@@ -865,6 +889,11 @@ class NetworkController(Controller):
                 self.stats["ev_frames"] += 1
                 self.cache.evict_bits(unpack_bits(payload))
                 continue
+            if magic == _MAGIC_ABORT:
+                from .exceptions import HorovodInternalError
+                self._set_broken(HorovodInternalError(
+                    payload.decode(errors="replace")))
+                return
             if magic == _MAGIC_PARAMS:
                 self.stats["pa_frames"] += 1
                 params = json.loads(payload.decode())
@@ -928,9 +957,9 @@ class NetworkController(Controller):
             parts = [self.cache.response_for_bit(b) for b in batch]
             if any(p is None for p in parts):
                 from .exceptions import HorovodInternalError
-                self._broken_err = HorovodInternalError(
+                self._set_broken(HorovodInternalError(
                     "response-cache desync: coordinator referenced a "
-                    "cache bit this rank does not hold")
+                    "cache bit this rank does not hold"))
                 return None
             responses.append(merge_responses(parts))
         return responses

@@ -68,6 +68,17 @@ class BackgroundRuntime:
         self._entry_sizes: Dict[str, int] = {}
         self._joined = False
         self._error: Optional[Exception] = None
+        # Called once when a fatal control-plane error surfaces (e.g.
+        # coordinator connection lost in an elastic resize): lets
+        # side-band machinery unblock FAST — the TF graph-collective
+        # layer aborts in-flight CollectiveReduceV2 waits so the user
+        # thread unwinds immediately instead of riding out the
+        # collective timeout while peers tear the world down.
+        self._fatal_listeners = []
+        self._fatal_fired = False
+        self._dispatch_disabled = False
+        if hasattr(self.controller, "set_broken_callback"):
+            self.controller.set_broken_callback(self._on_fatal)
 
     def set_joined(self, flag: bool):
         """While joined, this rank substitutes zeros for collectives it
@@ -153,15 +164,40 @@ class BackgroundRuntime:
             target=self._loop, name="hvd-tpu-background", daemon=True)
         self._thread.start()
 
-    def stop(self):
+    def stop_background(self):
+        """Halt the cycle loop WITHOUT detaching from the coordinator
+        — teardown sequencing needs the controller attachment as a
+        liveness signal (see basics.shutdown: the rank-0 coordinator
+        drain-waits on attachments, which lets non-leader ranks
+        disconnect their jax coordination client while the leader is
+        still alive; a leader going down under an attached client is
+        process-fatal in jax)."""
         self._shutdown.set()
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+
+    def quiesce(self):
+        """Stop executing NEW responses and fail outstanding
+        callbacks, while keeping the controller attached (see
+        stop_background).  Must precede backend teardown: the recv
+        thread direct-dispatches responses, so without this a frame
+        arriving mid-shutdown would execute against a closed/freed
+        backend."""
+        self.stop_background()
+        self._dispatch_disabled = True
+        self.tensor_queue.shutdown_flush()
+
+    def detach(self):
+        """Close the controller attachment and flush callbacks."""
         if hasattr(self.controller, "shutdown"):
             self.controller.shutdown()
         self.tensor_queue.shutdown_flush()
+
+    def stop(self):
+        self.stop_background()
+        self.detach()
 
     # ------------------------------------------------------------------
     # the cycle loop
@@ -177,20 +213,39 @@ class BackgroundRuntime:
                 self._run_once()
             except Exception as e:  # surface to future submitters
                 logger.exception("background runtime error")
-                self._error = e
-                self.tensor_queue.shutdown_flush(e)
+                self._on_fatal(e)
+                # A broken control plane never heals within a world
+                # incarnation — stop cycling (elastic re-init builds
+                # a fresh runtime) instead of re-raising every 1 ms.
+                return
+
+    def add_fatal_listener(self, fn):
+        self._fatal_listeners.append(fn)
+
+    def _on_fatal(self, err: Exception):
+        if self._fatal_fired:
+            return
+        self._fatal_fired = True
+        self._error = err
+        self.tensor_queue.shutdown_flush(err)
+        for fn in list(self._fatal_listeners):
+            try:
+                fn(err)
+            except Exception:
+                logger.warning("fatal listener failed", exc_info=True)
 
     def _dispatch_response(self, resp: Response):
         """Executes on the controller's recv thread (direct dispatch).
         Mirrors the background loop's error contract: a failure
         surfaces to future submitters and flushes outstanding
         callbacks."""
+        if self._dispatch_disabled:
+            return  # quiesced: entries already flushed with an error
         try:
             self._perform_operation(resp)
         except Exception as e:
             logger.exception("response dispatch error")
-            self._error = e
-            self.tensor_queue.shutdown_flush(e)
+            self._on_fatal(e)
 
     def _run_once(self):
         if self.timeline:

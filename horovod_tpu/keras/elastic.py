@@ -12,6 +12,13 @@ from .. import ops as _ops
 def _reset():
     basics.shutdown()
     basics.init()
+    # If the TF graph-collective layer is in play with elastic-graph
+    # mode, re-form its cluster at the new world size (guarded on the
+    # module being loaded: keras 3 may run on a non-TF backend).
+    import sys
+    g = sys.modules.get("horovod_tpu.tensorflow.graph_ops")
+    if g is not None and g._ctx.elastic_graph:
+        g.reset_graph_collectives()
 
 
 def run(func):
@@ -53,7 +60,7 @@ class KerasState(ObjectState):
         self._saved_opt_weights = [np.array(v) for v in self._opt_vars()]
         super().save()
 
-    def restore(self):
+    def _seed_from_snapshot(self):
         if self._saved_model_weights is not None:
             self.model.set_weights(self._saved_model_weights)
         opt_vars = self._opt_vars()
@@ -61,7 +68,20 @@ class KerasState(ObjectState):
                 len(opt_vars) == len(self._saved_opt_weights):
             for var, w in zip(opt_vars, self._saved_opt_weights):
                 var.assign(w)
+
+    def restore(self):
+        self._seed_from_snapshot()
         super().restore()
+
+    def rebuild(self, model, optimizer=None):
+        """Re-point the state at a freshly built model/optimizer and
+        seed them from the last snapshot — for
+        HOROVOD_TF_ELASTIC_GRAPH resets, where the TF context reset
+        invalidated the old objects (call from on_reset after
+        rebuilding the model)."""
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        self._seed_from_snapshot()
 
     def sync(self):
         weights = [np.asarray(_ops.broadcast(

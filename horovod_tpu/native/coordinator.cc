@@ -1319,6 +1319,14 @@ class Coordinator {
     first_seen_.clear();
     bit_only_.clear();
     if (!errs.empty()) BroadcastLocked(errs);
+    // Abort broadcast: workers with no pending eager negotiation
+    // (blocked in framework-plane collectives or compute) must learn
+    // the membership broke while this coordinator is still up, so
+    // they can disconnect their jax client before rank 0 takes the
+    // coordination service down (leader loss under an attached
+    // client is process-fatal).  Mirrors the Python coordinator.
+    BroadcastFrameLocked("AB",
+                         std::vector<uint8_t>(msg.begin(), msg.end()));
   }
 
   int size_;

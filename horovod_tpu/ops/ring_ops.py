@@ -242,10 +242,25 @@ class RingBackend(Backend):
                 pass
         return "127.0.0.1"
 
+    def _call(self, fn, *args) -> int:
+        """All C ring entry points run under the fusion lock: this
+        serializes direct concurrent callers and lets close() wait out
+        any in-flight collective before destroying the C comm (no
+        use-after-free).  The allreduce/reducescatter paths hold the
+        lock across their staging too and call the lib directly."""
+        with self._fusion_lock:
+            if self._comm is None:
+                raise RuntimeError("ring backend is closed")
+            return fn(self._comm, *args)
+
     def close(self):
         if self._comm is not None:
-            self._lib.hvd_ring_destroy(self._comm)
-            self._comm = None
+            # The fusion lock is held for the duration of every ring
+            # call, so acquiring it serializes destroy against any
+            # in-flight collective (no use-after-free on the C comm).
+            with self._fusion_lock:
+                self._lib.hvd_ring_destroy(self._comm)
+                self._comm = None
         # Clear rendezvous keys so a later init() against a persistent
         # jax.distributed client never reads this incarnation's
         # (now-dead) addresses.
@@ -440,8 +455,9 @@ class RingBackend(Backend):
                 *[int(t) * row_bytes for t in tsizes])
             total_rows = int(sum(tsizes))
             res = _aligned_empty((total_rows,) + a.shape[1:], a.dtype)
-            rc = self._lib.hvd_ring_allgather(
-                self._comm, a.ctypes.data_as(ctypes.c_void_p),
+            rc = self._call(
+                self._lib.hvd_ring_allgather,
+                a.ctypes.data_as(ctypes.c_void_p),
                 a.nbytes, res.ctypes.data_as(ctypes.c_void_p),
                 counts, ranks_arr, nranks)
             if rc != 0:
@@ -459,8 +475,9 @@ class RingBackend(Backend):
             # np.array (not ascontiguousarray, which promotes 0-d
             # arrays to 1-d) so scalars keep their shape.
             a = np.array(x, copy=True, order="C")
-            rc = self._lib.hvd_ring_broadcast(
-                self._comm, a.ctypes.data_as(ctypes.c_void_p),
+            rc = self._call(
+                self._lib.hvd_ring_broadcast,
+                a.ctypes.data_as(ctypes.c_void_p),
                 a.nbytes, int(root), ranks_arr, nranks)
             if rc != 0:
                 raise RuntimeError(f"ring broadcast failed (rc={rc})")
@@ -502,8 +519,9 @@ class RingBackend(Backend):
         # Split-matrix exchange (small): recv splits are column my_idx.
         mat = np.empty(gsize * gsize, np.int64)
         counts8 = (ctypes.c_longlong * gsize)(*([8 * gsize] * gsize))
-        rc = self._lib.hvd_ring_allgather(
-            self._comm, splits.ctypes.data_as(ctypes.c_void_p),
+        rc = self._call(
+            self._lib.hvd_ring_allgather,
+            splits.ctypes.data_as(ctypes.c_void_p),
             splits.nbytes, mat.ctypes.data_as(ctypes.c_void_p),
             counts8, ranks_arr, nranks)
         if rc != 0:
@@ -518,8 +536,9 @@ class RingBackend(Backend):
             *[int(s) * row_bytes for s in recv_splits])
         out = _aligned_empty((int(recv_splits.sum()),) + a.shape[1:],
                      a.dtype)
-        rc = self._lib.hvd_ring_alltoall(
-            self._comm, a.ctypes.data_as(ctypes.c_void_p),
+        rc = self._call(
+            self._lib.hvd_ring_alltoall,
+            a.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p), sendcounts, recvcounts,
             ranks_arr, nranks)
         if rc != 0:
@@ -604,7 +623,8 @@ class RingBackend(Backend):
 
     def barrier(self, ps_ranks=()):
         ranks_arr, nranks, _ = self._group_args(tuple(ps_ranks))
-        rc = self._lib.hvd_ring_barrier(self._comm, ranks_arr, nranks)
+        rc = self._call(self._lib.hvd_ring_barrier, ranks_arr,
+                        nranks)
         if rc != 0:
             raise RuntimeError(f"ring barrier failed (rc={rc})")
         return None

@@ -38,7 +38,8 @@ from .. import ops as _ops
 from ..ops.compression import Compression
 from ..ops.eager import _resolve_op
 from . import graph_ops as _graph
-from .graph_ops import enable_graph_collectives
+from .graph_ops import (enable_graph_collectives,
+                        reset_graph_collectives)
 
 __all__ = [
     "init", "shutdown", "rank", "size", "local_rank", "local_size",
@@ -55,6 +56,7 @@ __all__ = [
     "broadcast_object", "allgather_object",
     "DistributedOptimizer", "DistributedGradientTape",
     "SyncBatchNormalization", "elastic", "enable_graph_collectives",
+    "reset_graph_collectives",
 ]
 
 

@@ -13,12 +13,39 @@ from ..keras.elastic import KerasState as TensorFlowKerasState  # noqa: F401
 def _reset():
     basics.shutdown()
     basics.init()
+    from . import graph_ops
+    if graph_ops._ctx.elastic_graph:
+        # Opt-in (HOROVOD_TF_ELASTIC_GRAPH=1): re-form the collective
+        # cluster at the new world size via a full TF context reset.
+        # Model/functions must be rebuilt in on_reset; see
+        # reset_graph_collectives.
+        graph_ops.reset_graph_collectives()
 
 
 def run(func):
     """Elastic retry-loop decorator (reference: tensorflow/elastic.py
-    run)."""
-    return run_fn(func, _reset)
+    run).  TF connection-class errors (a peer dying inside an
+    in-graph CollectiveReduceV2 surfaces as UnavailableError, not
+    HorovodInternalError) are translated so the retry loop can
+    restore/reset — the eager path's op wrappers already raise
+    HorovodInternalError themselves."""
+    def tf_guard(state, *args, **kwargs):
+        import tensorflow as tf
+        from ..common.exceptions import HorovodInternalError
+        try:
+            return func(state, *args, **kwargs)
+        except (tf.errors.UnavailableError, tf.errors.AbortedError,
+                tf.errors.CancelledError,
+                tf.errors.DeadlineExceededError) as e:
+            # Distributed-failure codes only: Unavailable/Aborted are
+            # what a dead peer or an abort_collective_ops produces,
+            # Cancelled is what subsequent ops on the aborted executor
+            # produce, DeadlineExceeded is the collective timeout.
+            # Deterministic local failures (InternalError from a
+            # compiler bug, InvalidArgument, ...) must SURFACE, not
+            # loop the retry forever.
+            raise HorovodInternalError(str(e)) from e
+    return run_fn(tf_guard, _reset)
 
 
 class TensorFlowState(ObjectState):
@@ -41,11 +68,22 @@ class TensorFlowState(ObjectState):
         self._saved = [np.array(v) for v in self.variables]
         super().save()
 
-    def restore(self):
+    def _seed_from_snapshot(self):
         if self._saved is not None:
             for var, w in zip(self.variables, self._saved):
                 var.assign(w)
+
+    def restore(self):
+        self._seed_from_snapshot()
         super().restore()
+
+    def rebuild(self, variables):
+        """Re-point the state at freshly built variables and seed them
+        from the last snapshot — for HOROVOD_TF_ELASTIC_GRAPH resets,
+        where the TF context reset invalidated the old objects (call
+        from on_reset after rebuilding the model)."""
+        self.variables = list(variables)
+        self._seed_from_snapshot()
 
     def sync(self):
         for i, var in enumerate(self.variables):

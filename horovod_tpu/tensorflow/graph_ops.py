@@ -76,6 +76,30 @@ class _GraphCollectives:
         self._check_seq = 0
         self._key_hash = ""
 
+    def effective_timeout(self) -> float:
+        # A peer dying right before a collective can leave the
+        # survivors waiting forever (no connection reset to unblock
+        # them); elastic needs a bounded wait so the retry loop gets
+        # control.  Evaluated per trace (not snapshotted) for the same
+        # reason as elastic_graph below.
+        if self.timeout:
+            return self.timeout
+        return 30.0 if self.elastic_graph else 0.0
+
+    @property
+    def elastic_graph(self) -> bool:
+        """Opt-in elastic mode: graph collectives survive a resize by
+        a FULL TF context reset + cluster re-formation on every
+        elastic reset (see reset_graph_collectives).  Opt-in because
+        the context reset invalidates all live TF objects — user code
+        must rebuild model/functions in on_reset (State.rebuild
+        re-points the snapshots).  Read per call, not snapshotted at
+        import: programs commonly set the env var from their own CLI
+        flags after this module is already imported."""
+        return os.environ.get(
+            "HOROVOD_TF_ELASTIC_GRAPH", "").strip().lower() \
+            in ("1", "true", "on")
+
     # -- lifecycle -------------------------------------------------------
     def enable(self) -> bool:
         """Collective call: every rank of the global process set must
@@ -106,10 +130,13 @@ class _GraphCollectives:
         size, rank = basics.size(), basics.rank()
         if size == 1:
             raise RuntimeError("single process")
-        if basics._state().knobs.elastic:
+        if basics._state().knobs.elastic and not self.elastic_graph:
             raise RuntimeError(
                 "graph collectives are incompatible with elastic runs "
-                "(group sizes are baked into traced graphs)")
+                "(group sizes are baked into traced graphs); set "
+                "HOROVOD_TF_ELASTIC_GRAPH=1 to opt into context-reset "
+                "re-formation on resize (model must be rebuilt in "
+                "on_reset)")
         # The enable decision must be unanimous: a rank whose TF
         # context is already live cannot join the cluster (enabling
         # would invalidate its existing tensors), a rank with the kill
@@ -162,6 +189,24 @@ class _GraphCollectives:
                 f"{[i for i, v in enumerate(outcomes) if not v]}; all "
                 "ranks fall back to the py_function path")
         self.device = f"/job:worker/replica:0/task:{rank}/device:CPU:0"
+        # Fail-fast wiring: when the control plane dies mid-run (a
+        # peer hard-died in an elastic resize), abort in-flight TF
+        # collectives so the user thread unwinds NOW instead of
+        # riding out timeout_seconds while the rest of the world
+        # tears down (a slow unwind here is what lets the jax
+        # coordination leader disappear under a still-attached
+        # client, which is process-fatal).
+        runtime = getattr(basics._state(), "runtime", None)
+        if runtime is not None and hasattr(runtime,
+                                           "add_fatal_listener"):
+            def abort_tf_collectives(err):
+                try:
+                    context.context().abort_collective_ops(
+                        14,  # UNAVAILABLE
+                        f"horovod control plane failed: {err}")
+                except Exception:
+                    pass
+            runtime.add_fatal_listener(abort_tf_collectives)
 
     @staticmethod
     def _my_ip() -> str:
@@ -185,8 +230,11 @@ class _GraphCollectives:
         # Elastic runs resize the world; traced graphs bake group_size
         # and the gRPC cluster at trace time, so reused graphs would
         # execute stale collectives after a resize. Elastic stays on
-        # the execution-time (py_function) path.
-        if basics.is_initialized() and basics._state().knobs.elastic:
+        # the execution-time (py_function) path — unless the user
+        # opted into context-reset re-formation
+        # (HOROVOD_TF_ELASTIC_GRAPH=1, see reset_graph_collectives).
+        if basics.is_initialized() and basics._state().knobs.elastic \
+                and not self.elastic_graph:
             return False
         if dtype is not None and tf.as_dtype(dtype) not in _SUPPORTED_DTYPES:
             return False
@@ -295,6 +343,32 @@ def enable_graph_collectives() -> bool:
     return _ctx.enable()
 
 
+def reset_graph_collectives() -> bool:
+    """Re-form the collective cluster at the CURRENT world size after
+    an elastic resize.  Collective call: every post-resize rank must
+    enter (the elastic reset path does this automatically under
+    ``HOROVOD_TF_ELASTIC_GRAPH=1``).
+
+    TF refuses to shrink a live cluster (``update_server_def``
+    rejects removed tasks), so survival goes through a FULL eager
+    context reset: every live TF tensor/variable/function dies, a
+    fresh context enables collective ops against the new cluster, and
+    user code rebuilds its model/functions in ``on_reset`` (elastic
+    State snapshots are numpy and survive; ``State.rebuild`` re-points
+    them at the fresh objects).  The reference never solved this —
+    its elastic TF path re-creates graphs per reset too (exec-time
+    size ops, tensorflow/mpi_ops.py:327-391); the context reset is
+    the TF2-collective-ops equivalent."""
+    global _ctx
+    from tensorflow.python.eager import context
+    if context.context()._context_handle is not None:
+        context._reset_context()
+    _ctx = _GraphCollectives()
+    if basics.size() == 1:
+        return True
+    return _ctx.enable()
+
+
 def reset_graph_collectives_for_testing():
     global _ctx
     _ctx = _GraphCollectives()
@@ -328,7 +402,7 @@ def allreduce_graph(tensor, op, prescale_factor, postscale_factor,
         input=tensor, group_size=group_size, group_key=group_key,
         instance_key=ikey, ordering_token=[],
         merge_op=merge_op, final_op=final_op,
-        communication_hint="ring", timeout_seconds=_ctx.timeout)
+        communication_hint="ring", timeout_seconds=_ctx.effective_timeout())
     return _scaled(out, postscale_factor)
 
 
@@ -348,7 +422,7 @@ def allgather_graph(tensor, process_set):
     return tf.raw_ops.CollectiveGatherV2(
         input=tensor, group_size=group_size, group_key=group_key,
         instance_key=ikey, ordering_token=[],
-        communication_hint="ring", timeout_seconds=_ctx.timeout)
+        communication_hint="ring", timeout_seconds=_ctx.effective_timeout())
 
 
 def broadcast_graph(tensor, root_rank, process_set):
@@ -361,7 +435,7 @@ def broadcast_graph(tensor, root_rank, process_set):
     kwargs = dict(group_size=group_size, group_key=group_key,
                   instance_key=ikey,
                   communication_hint="ring",
-                  timeout_seconds=_ctx.timeout)
+                  timeout_seconds=_ctx.effective_timeout())
     if basics.rank() == root_rank:
         return tf.raw_ops.CollectiveBcastSendV2(input=tensor, **kwargs)
     return tf.raw_ops.CollectiveBcastRecvV2(
@@ -382,4 +456,4 @@ def reducescatter_graph(tensor, op, process_set):
         input=tensor, group_size=group_size, group_key=group_key,
         instance_key=ikey, ordering_token=[],
         merge_op=merge_op, final_op=final_op,
-        communication_hint="ring", timeout_seconds=_ctx.timeout)
+        communication_hint="ring", timeout_seconds=_ctx.effective_timeout())
