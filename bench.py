@@ -234,6 +234,58 @@ def bench_bert(args, smoke: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Keras-on-JAX training benchmark (the Keras TPU story: compute inside
+# keras's jit-compiled jax train step; reference config keras_mnist.py)
+# ---------------------------------------------------------------------------
+
+def bench_keras_jax(args, smoke: bool) -> dict:
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+    if keras.backend.backend() != "jax":
+        return {"error": "keras backend is %r (KERAS_BACKEND was set "
+                         "after keras import?)" % keras.backend.backend()}
+    import numpy as np
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    if smoke:
+        batch, n = 64, 1024
+        model = keras.Sequential([
+            keras.layers.Input((28, 28, 1)), keras.layers.Flatten(),
+            keras.layers.Dense(64, activation="relu"),
+            keras.layers.Dense(10, activation="softmax")])
+    else:
+        batch, n = args.batch_size or 128, 16384
+        model = keras.Sequential([
+            keras.layers.Input((28, 28, 1)),
+            keras.layers.Conv2D(32, 3, activation="relu"),
+            keras.layers.MaxPooling2D(),
+            keras.layers.Conv2D(64, 3, activation="relu"),
+            keras.layers.MaxPooling2D(),
+            keras.layers.Flatten(),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dense(10, activation="softmax")])
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, n)
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(1e-3))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=batch, epochs=1, verbose=0)  # compile
+    t0 = time.perf_counter()
+    model.fit(x, y, batch_size=batch, epochs=1, verbose=0)
+    dt = time.perf_counter() - t0
+    dev = {d.platform for v in model.trainable_variables
+           for d in v.value.devices()}
+    return {
+        "samples_per_sec": round(n / dt, 2),
+        "batch_size": batch,
+        "backend": "jax",
+        "param_device": sorted(dev),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Eager allreduce micro-benchmark (2 real processes, real control plane)
 # ---------------------------------------------------------------------------
 
@@ -432,7 +484,9 @@ def main():
     p.add_argument("--bert-seq", type=int, default=128)
     p.add_argument("--num-iters", type=int, default=50)
     p.add_argument("--warmup", type=int, default=5)
-    p.add_argument("--only", choices=["resnet", "bert", "collectives"],
+    p.add_argument("--only",
+               choices=["resnet", "bert", "keras",
+                        "collectives"],
                    default=None)
     args = p.parse_args()
 
@@ -463,7 +517,8 @@ def main():
     if tpu_error:
         out["tpu_error"] = tpu_error
 
-    run = {args.only} if args.only else {"resnet", "bert", "collectives"}
+    run = {args.only} if args.only else {"resnet", "bert", "keras",
+                                     "collectives"}
 
     resnet = {}
     if "resnet" in run:
@@ -478,6 +533,13 @@ def main():
         try:
             out[key] = bench_bert(args, args.smoke)
         except Exception as e:  # OOM on small chips must not kill the run
+            out[key] = {"error": repr(e)[:300]}
+    if "keras" in run:
+        key = "keras_mnist_jax" if not args.smoke \
+            else "keras_mnist_jax_smoke"
+        try:
+            out[key] = bench_keras_jax(args, args.smoke)
+        except Exception as e:
             out[key] = {"error": repr(e)[:300]}
     if "collectives" in run:
         sizes = [1] if args.smoke else [1, 4, 16, 64, 256]

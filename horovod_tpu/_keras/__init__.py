@@ -4,9 +4,13 @@
 helpers).
 
 Built against Keras 3 (``tf.keras`` is Keras 3 in TF ≥ 2.16): the
-override point is ``apply_gradients``, which every backend's train step
-calls.  Gradients stage through host memory into the background
-runtime, matching the TF binding's design.
+override point is ``BaseOptimizer.apply`` — both the TF trainer's
+``apply_gradients`` and the JAX trainer's ``stateless_apply`` funnel
+through it.  On the TF backend, gradients reduce via the in-graph /
+py_function TF plane; on the JAX backend they reduce from INSIDE
+keras's jit-compiled train step via ``io_callback`` into the fused
+collective data plane (on TPU: XLA collectives over ICI), so model
+compute never leaves the chip.
 """
 
 from typing import List, Optional
@@ -19,20 +23,87 @@ from .. import ops as _ops
 from ..ops.compression import Compression
 
 
+def _scales(op, gradient_predivide_factor, process_set):
+    # Resolved at CALL time, never frozen at optimizer creation:
+    # process_set.size() changes across elastic resets (same
+    # convention as tensorflow/__init__.py _make_allreduce_grads_fn).
+    if op == Average:
+        return (1.0 / gradient_predivide_factor,
+                gradient_predivide_factor / process_set.size(), Sum)
+    return 1.0, 1.0, op
+
+
+def _jax_grads_fn(compression, op, gradient_predivide_factor,
+                  process_set):
+    """Gradient reduction for the Keras-3 JAX backend.
+
+    Keras's JAX trainer jit-compiles the whole train step and calls
+    ``optimizer.stateless_apply`` INSIDE the traced program, so the
+    reduction must be traceable: ``jax.experimental.io_callback``
+    suspends the compiled step, runs the grouped allreduce on the
+    eager data plane (on TPU that is the fused XLA collective over
+    ICI — the same structure as the reference's GPU-compute +
+    NCCL-enqueue split, tensorflow/mpi_ops.cc:374-428), and resumes
+    on-chip.  ``ordered=True`` keeps the per-rank submission order
+    identical, which the coordinator's fusion relies on."""
+    import jax
+    from jax.experimental import io_callback
+    from .. import ops as _ops
+
+    def host_reduce(*arrs):
+        # Runs EAGERLY once per step (the compiled program suspends
+        # into it), so world size and scale factors track elastic
+        # resizes even though the traced program is cached.
+        prescale, postscale, reduce_op = _scales(
+            op, gradient_predivide_factor, process_set)
+        arrs = [np.asarray(a) for a in arrs]
+        compressed, ctxs = [], []
+        for a in arrs:
+            c, ctx = compression.compress(a)
+            compressed.append(c)
+            ctxs.append(ctx)
+        reduced = _ops.grouped_allreduce(
+            compressed, op=reduce_op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=process_set)
+        return tuple(
+            np.ascontiguousarray(compression.decompress(r, ctx))
+            for r, ctx in zip(reduced, ctxs))
+
+    def allreduce_grads(grads, variables=None):
+        grads = list(grads)
+        index = [i for i, g in enumerate(grads) if g is not None]
+        # The skip may be decided at TRACE time only when the world
+        # can never grow (non-elastic): the callback must be baked
+        # into the cached program whenever a resize could make it
+        # necessary later.
+        static_single = (process_set.size() == 1 and
+                         not basics._state().knobs.elastic)
+        if not index or static_single:
+            return grads
+        flat = [grads[i] for i in index]
+        shapes = tuple(jax.ShapeDtypeStruct(g.shape, g.dtype)
+                       for g in flat)
+        reduced = io_callback(host_reduce, shapes, *flat,
+                              ordered=True)
+        if not isinstance(reduced, (list, tuple)):
+            reduced = (reduced,)
+        for i, r in zip(index, reduced):
+            grads[i] = r
+        return grads
+
+    return allreduce_grads
+
+
 def _backend_grads_fn(compression, op, gradient_predivide_factor,
                       process_set):
-    """Backend-neutral gradient reduction via keras.ops conversion —
-    used when TensorFlow is not installed (Keras on the JAX backend)."""
+    """Backend-neutral (eager) gradient reduction via keras.ops
+    conversion — the fallback for backends without a dedicated path."""
     from keras import ops as K
     from .. import ops as _ops
 
     def allreduce_grads(grads, variables=None):
-        if op == Average:
-            prescale = 1.0 / gradient_predivide_factor
-            postscale = gradient_predivide_factor / process_set.size()
-            reduce_op = Sum
-        else:
-            prescale, postscale, reduce_op = 1.0, 1.0, op
+        prescale, postscale, reduce_op = _scales(
+            op, gradient_predivide_factor, process_set)
         index = [i for i, g in enumerate(grads) if g is not None]
         arrs = [np.asarray(K.convert_to_numpy(grads[i])) for i in index]
         compressed, ctxs = [], []
@@ -64,8 +135,8 @@ def create_distributed_optimizer(optimizer, name=None,
                                  make_allreduce_grads_fn=None):
     if make_allreduce_grads_fn is None:
         # Pick by the ACTIVE Keras backend, not TF importability: with
-        # KERAS_BACKEND=jax the trainer feeds JAX arrays, which must
-        # not route through tf.py_function.
+        # KERAS_BACKEND=jax the trainer feeds JAX arrays (often
+        # tracers), which must not route through tf.py_function.
         import keras
         if keras.backend.backend() == "tensorflow":
             try:
@@ -79,25 +150,45 @@ def create_distributed_optimizer(optimizer, name=None,
             sparse_as_dense, op, gradient_predivide_factor, num_groups,
             process_set)
     else:
-        allreduce_grads = _backend_grads_fn(
-            compression, op, gradient_predivide_factor, process_set)
+        import keras
+        if keras.backend.backend() == "jax":
+            allreduce_grads = _jax_grads_fn(
+                compression, op, gradient_predivide_factor,
+                process_set)
+        else:
+            allreduce_grads = _backend_grads_fn(
+                compression, op, gradient_predivide_factor,
+                process_set)
 
     cls = optimizer.__class__
 
     class _DistributedOptimizer(cls):
         _hvd_distributed = True
 
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        # The hook point is ``apply``: EVERY path funnels through it —
+        # eager/TF ``apply_gradients`` delegates to it, and the JAX
+        # trainer's jitted train step calls ``stateless_apply``, which
+        # invokes ``apply`` directly (so an apply_gradients-only
+        # override would silently skip gradient sync under
+        # KERAS_BACKEND=jax model.fit).
+        def apply(self, grads, trainable_variables=None):
             try:
                 import tensorflow as tf
                 eager = tf.executing_eagerly()
             except ImportError:
                 eager = True
-            grads_and_vars = list(grads_and_vars)
-            grads = [g for g, _ in grads_and_vars]
-            variables = [v for _, v in grads_and_vars]
+            grads = list(grads)
             if self._hvd_backward_passes > 1:
-                if not eager:
+                try:
+                    import jax as _jax
+                    traced = any(isinstance(g, _jax.core.Tracer)
+                                 for g in grads)
+                except ImportError:
+                    traced = False
+                if not eager or traced:
+                    # tf.executing_eagerly() is True during JAX
+                    # tracing (TF isn't the one tracing), so the
+                    # tracer check catches the jitted-jax train step.
                     raise NotImplementedError(
                         "backward_passes_per_step > 1 requires eager "
                         "execution (compile with run_eagerly=True); the "
@@ -106,9 +197,9 @@ def create_distributed_optimizer(optimizer, name=None,
                 grads = self._hvd_accumulate(grads)
                 if grads is None:
                     return None
-            reduced = self._hvd_allreduce_grads(grads, variables)
-            return super().apply_gradients(
-                zip(reduced, variables), *args, **kwargs)
+            reduced = self._hvd_allreduce_grads(
+                grads, trainable_variables)
+            return super().apply(reduced, trainable_variables)
 
         def _hvd_accumulate(self, grads):
             acc = self.__dict__.setdefault("_hvd_acc", None)

@@ -3,8 +3,11 @@
 callbacks, elastic).
 
 Works with standalone Keras 3 and ``tf.keras`` alike: the optimizer
-wrapper overrides ``apply_gradients``, which every Keras 3 backend's
-train step calls.
+wrapper overrides ``BaseOptimizer.apply`` — the funnel point for both
+the TF trainer (``apply_gradients`` delegates to it) and the JAX
+trainer's jit-compiled ``stateless_apply`` (which calls it directly;
+an ``apply_gradients``-only override would silently skip gradient
+sync under ``KERAS_BACKEND=jax``).
 """
 
 import keras
