@@ -8,9 +8,10 @@ gradients from inside that compiled step (``io_callback`` into the
 fused collective data plane — on TPU, XLA collectives over ICI).  No
 TensorFlow, no py_function, no per-op host staging of activations.
 
-Run:  KERAS_BACKEND=jax horovodrun -np 2 -H localhost:2 \\
+Run (one rank per chip):
+      KERAS_BACKEND=jax horovodrun -np 2 -H localhost:2 \\
           python keras_mnist_jax.py --epochs 1
-Single TPU host (8 chips, pure XLA data parallelism — no processes):
+Single TPU host (8 chips, pure XLA data parallelism, ONE process):
       KERAS_BACKEND=jax python keras_mnist_jax.py --data-parallel
 """
 
@@ -46,12 +47,20 @@ def main():
         f"active backend: {keras.backend.backend()}")
 
     if args.data_parallel:
-        # Intra-process chips: XLA GSPMD shards the batch over the
-        # local mesh; hvd handles the cross-process axis on top.
+        # Single-process multi-chip: XLA GSPMD shards the batch over
+        # the local mesh — no worker processes, no hvd collectives
+        # (with size 1 the optimizer wrapper emits none).  For
+        # multi-process runs launch one rank per chip instead; the
+        # two modes don't compose (an ordered host callback can't
+        # lower into a multi-device computation).
         keras.distribution.set_distribution(
             keras.distribution.DataParallel())
 
     hvd.init()
+    if args.data_parallel and hvd.size() > 1:
+        raise SystemExit(
+            "--data-parallel is the single-process mode; for "
+            f"size={hvd.size()} launch one rank per chip instead")
 
     if args.synthetic:
         x_train = np.random.rand(4096, 28, 28, 1).astype("float32")

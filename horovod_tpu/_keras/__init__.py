@@ -48,7 +48,6 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
     identical, which the coordinator's fusion relies on."""
     import jax
     from jax.experimental import io_callback
-    from .. import ops as _ops
 
     def host_reduce(*arrs):
         # Runs EAGERLY once per step (the compiled program suspends
@@ -80,6 +79,20 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
                          not basics._state().knobs.elastic)
         if not index or static_single:
             return grads
+        if jax.local_device_count() > 1:
+            # ordered io_callback cannot lower into a multi-device
+            # computation (and per-shard callback fan-out would desync
+            # the coordinator's counts).  Multi-chip topology choices:
+            # one process per chip (classic Horovod ranks), or
+            # keras.distribution.DataParallel WITHIN a single process
+            # (no hvd collectives needed), or the sharded trainers in
+            # horovod_tpu.training for pod-scale meshes.
+            raise NotImplementedError(
+                "hvd.DistributedOptimizer on the Keras JAX backend "
+                "supports one device per process when size > 1; got "
+                f"{jax.local_device_count()} local devices. Launch "
+                "one rank per chip, or use "
+                "keras.distribution.DataParallel single-process.")
         flat = [grads[i] for i in index]
         shapes = tuple(jax.ShapeDtypeStruct(g.shape, g.dtype)
                        for g in flat)

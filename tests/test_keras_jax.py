@@ -24,7 +24,6 @@ hvd.init()
 # the shared weights proves gradients are averaged ACROSS ranks (one
 # rank alone would fit a different least-squares solution on its
 # half-interval shard).
-rng = np.random.RandomState(RANK)
 x = (np.linspace(0, 1, 256)[RANK::SIZE]).astype("float32")[:, None]
 y = 2.0 * x + 0.5
 

@@ -1,0 +1,164 @@
+"""One-shot ResNet-50 step profile for the MFU ceiling analysis
+(VERDICT r4 item 1b).
+
+Captures, in a single TPU session (compiles are expensive on the
+1-core host driving the tunnel):
+
+  * XLA cost analysis of the jitted train step (FLOPs, bytes
+    accessed, arithmetic intensity);
+  * an HLO-op histogram of the optimized module (convolution /
+    fusion / reduce / copy counts) — copies and converts are the
+    usual MFU leaks;
+  * measured step time -> achieved TFLOP/s and MFU vs the chip peak;
+  * optionally a profiler trace (--trace DIR, view in XProf).
+
+Usage (on a host with the TPU attached):
+    python tools/profile_resnet.py --batch-size 128 --iters 30
+    python tools/profile_resnet.py --batch-size 128 --trace /tmp/tb
+"""
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--trace", type=str, default=None,
+                   help="capture a jax.profiler trace into this dir")
+    p.add_argument("--cpu", action="store_true",
+                   help="force CPU (pipeline debugging)")
+    args = p.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from functools import partial
+
+    from bench import compiled_flops, peak_bf16_tflops
+    from horovod_tpu.models import ResNet50
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+
+    model = ResNet50(num_classes=1000)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(args.batch_size, args.image_size,
+                             args.image_size, 3), dtype=jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 1000, args.batch_size),
+                         dtype=jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, x, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x,
+            train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.take_along_axis(logp, labels[:, None],
+                                    axis=-1).mean()
+        return loss, updates["batch_stats"]
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, batch_stats, opt_state, x, labels):
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, x, labels)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_bs,
+                new_opt, loss)
+
+    print("lowering/compiling...", flush=True)
+    t0 = time.perf_counter()
+    lowered = train_step.lower(params, batch_stats, opt_state, x,
+                               labels)
+    compiled = lowered.compile()
+    print(f"compile: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    # --- cost analysis ---------------------------------------------------
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    nbytes = float(ca.get("bytes accessed", 0.0))
+    report = {
+        "batch_size": args.batch_size,
+        "flops_per_step": flops,
+        "bytes_accessed_per_step": nbytes,
+        "arithmetic_intensity": round(flops / nbytes, 1)
+        if nbytes else None,
+    }
+
+    # --- HLO op histogram ------------------------------------------------
+    try:
+        hlo = compiled.as_text()
+        hist = collections.Counter()
+        for m in re.finditer(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+                             r"[\w\[\],{}\d\s]*?\s([a-z\-]+)\(",
+                             hlo, re.M):
+            hist[m.group(1)] += 1
+        interesting = {k: v for k, v in hist.most_common(20)}
+        report["hlo_op_histogram"] = interesting
+        report["hlo_copies"] = hist.get("copy", 0)
+        report["hlo_convs"] = (hist.get("convolution", 0) +
+                               hist.get("conv", 0))
+        report["hlo_fusions"] = hist.get("fusion", 0)
+    except Exception as e:
+        report["hlo_error"] = repr(e)[:200]
+
+    # --- timed run (drive the AOT executable: calling the jit wrapper
+    # would retrace + recompile a second time) -----------------------------
+    def run(n, p_, bs_, os_):
+        loss = None
+        for _ in range(n):
+            p_, bs_, os_, loss = compiled(p_, bs_, os_, x, labels)
+        if loss is not None:
+            float(loss)
+        return p_, bs_, os_
+
+    params, batch_stats, opt_state = run(args.warmup, params,
+                                         batch_stats, opt_state)
+    if args.trace:
+        import jax.profiler
+        jax.profiler.start_trace(args.trace)
+    t0 = time.perf_counter()
+    params, batch_stats, opt_state = run(args.iters, params,
+                                         batch_stats, opt_state)
+    dt = time.perf_counter() - t0
+    if args.trace:
+        jax.profiler.stop_trace()
+        report["trace_dir"] = args.trace
+
+    step_s = dt / args.iters
+    peak = peak_bf16_tflops(dev)
+    achieved = flops / step_s / 1e12
+    report.update({
+        "step_ms": round(step_s * 1e3, 2),
+        "images_per_sec": round(args.batch_size / step_s, 1),
+        "achieved_tflops": round(achieved, 1),
+        "peak_bf16_tflops": peak or None,
+        "mfu": round(achieved / peak, 4) if peak else None,
+        # HBM roofline: step time implied by bytes at ~819 GB/s (v5e).
+        "hbm_bound_step_ms": round(nbytes / 819e9 * 1e3, 2)
+        if nbytes else None,
+    })
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()
