@@ -89,7 +89,11 @@ def _timed_loop(step, carry, warmup, iters, fetch_scalar):
 # ResNet-50 synthetic training benchmark
 # ---------------------------------------------------------------------------
 
-def bench_resnet(args, smoke: bool) -> dict:
+def build_resnet_train_step(batch_size: int, image_size: int,
+                            num_classes: int, smoke: bool = False):
+    """The benchmark train step, shared with tools/profile_resnet.py
+    so the profiler measures EXACTLY the program the benchmark runs.
+    Returns (train_step, params, batch_stats, opt_state, x, labels)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -98,21 +102,12 @@ def bench_resnet(args, smoke: bool) -> dict:
 
     from horovod_tpu.models import ResNet50, ResNet18
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    if smoke:
-        model = ResNet18(num_classes=10)
-        batch_size, img, iters, warmup = args.batch_size or 8, 32, 5, 2
-    else:
-        model = ResNet50(num_classes=1000)
-        batch_size = args.batch_size or (128 if on_tpu else 16)
-        img, iters, warmup = 224, args.num_iters, args.warmup
-
+    model = (ResNet18 if smoke else ResNet50)(num_classes=num_classes)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch_size, img, img, 3), dtype=jnp.bfloat16)
-    labels = jnp.asarray(rng.randint(0, 10 if smoke else 1000, batch_size),
+    x = jnp.asarray(rng.rand(batch_size, image_size, image_size, 3),
+                    dtype=jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, num_classes, batch_size),
                          dtype=jnp.int32)
-
     variables = model.init(jax.random.PRNGKey(0), x, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.01, momentum=0.9)
@@ -120,10 +115,11 @@ def bench_resnet(args, smoke: bool) -> dict:
 
     def loss_fn(params, batch_stats, x, labels):
         logits, updates = model.apply(
-            {"params": params, "batch_stats": batch_stats}, x, train=True,
-            mutable=["batch_stats"])
+            {"params": params, "batch_stats": batch_stats}, x,
+            train=True, mutable=["batch_stats"])
         logp = jax.nn.log_softmax(logits)
-        loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        loss = -jnp.take_along_axis(logp, labels[:, None],
+                                    axis=-1).mean()
         return loss, updates["batch_stats"]
 
     # Donation lets XLA update params/opt state in place (no HBM copies
@@ -136,12 +132,33 @@ def bench_resnet(args, smoke: bool) -> dict:
         new_params = optax.apply_updates(params, updates)
         return new_params, new_bs, new_opt, loss
 
+    return train_step, params, batch_stats, opt_state, x, labels
+
+
+def resnet50_analytic_flops(batch_size: int) -> float:
+    """ResNet-50 fwd ≈ 4.1 GFLOPs/image at 224²; training ≈ 3× fwd."""
+    return 3 * 4.1e9 * batch_size
+
+
+def bench_resnet(args, smoke: bool) -> dict:
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if smoke:
+        batch_size, img, iters, warmup = args.batch_size or 8, 32, 5, 2
+    else:
+        batch_size = args.batch_size or (128 if on_tpu else 16)
+        img, iters, warmup = 224, args.num_iters, args.warmup
+
+    (train_step, params, batch_stats, opt_state, x,
+     labels) = build_resnet_train_step(
+        batch_size, img, 10 if smoke else 1000, smoke=smoke)
+
     step_flops = compiled_flops(train_step, params, batch_stats, opt_state,
                                 x, labels)
     if not step_flops and not smoke:
-        # Analytic fallback: ResNet-50 fwd ≈ 4.1 GFLOPs/image at 224²;
-        # fwd + backward ≈ 3× fwd.
-        step_flops = 3 * 4.1e9 * batch_size
+        step_flops = resnet50_analytic_flops(batch_size)
 
     dt = _timed_loop(
         lambda c: train_step(c[0], c[1], c[2], x, labels),
@@ -308,9 +325,12 @@ for mb in sizes_mb:
         if kind == "jax":
             buf = jax.numpy.asarray(buf)
         name = "bench.%s.%s" % (mb, kind)
-        # Warmup: negotiation + compile; later iterations ride the
-        # response-cache fast path (CH/CB frames).
-        for _ in range(2):
+        # Warmup: negotiation + compile, growing the persistent fusion
+        # staging buffer and faulting in fresh output pages; 3 rounds
+        # so the first timed iteration of each size/kind measures the
+        # steady state, not allocator churn (measured: the first lane
+        # at a new size otherwise reads ~30% low).
+        for _ in range(3):
             out = hvd.allreduce(buf, op=hvd.Sum, name=name)
         np.asarray(out)
         t0 = time.perf_counter()

@@ -82,17 +82,22 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
         if jax.local_device_count() > 1:
             # ordered io_callback cannot lower into a multi-device
             # computation (and per-shard callback fan-out would desync
-            # the coordinator's counts).  Multi-chip topology choices:
-            # one process per chip (classic Horovod ranks), or
-            # keras.distribution.DataParallel WITHIN a single process
-            # (no hvd collectives needed), or the sharded trainers in
-            # horovod_tpu.training for pod-scale meshes.
+            # the coordinator's counts).
             raise NotImplementedError(
                 "hvd.DistributedOptimizer on the Keras JAX backend "
-                "supports one device per process when size > 1; got "
-                f"{jax.local_device_count()} local devices. Launch "
-                "one rank per chip, or use "
-                "keras.distribution.DataParallel single-process.")
+                "needs exactly one visible device per process when "
+                f"size > 1; this rank sees "
+                f"{jax.local_device_count()}. Supported topologies: "
+                "(a) processes that each own one chip — multi-host "
+                "pods where workers are per-chip VMs, or hosts where "
+                "the operator pins chips per process via the TPU "
+                "runtime env (TPU_VISIBLE_CHIPS et al.) / "
+                "CUDA_VISIBLE_DEVICES / "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=1; "
+                "(b) a SINGLE process using "
+                "keras.distribution.DataParallel over its local "
+                "chips; (c) horovod_tpu.training's sharded trainers "
+                "for pod-scale meshes.")
         flat = [grads[i] for i in index]
         shapes = tuple(jax.ShapeDtypeStruct(g.shape, g.dtype)
                        for g in flat)

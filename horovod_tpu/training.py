@@ -11,6 +11,7 @@ drop-in per-gradient API also exists (horovod_tpu.jax) but this is the
 path that hits peak MXU/ICI utilisation.
 """
 
+import logging
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -33,9 +34,22 @@ def factor_mesh_axes(n_devices: int,
                      absorb: str = "dp") -> Dict[str, int]:
     """Factor a device count into 2s over the named axes, in order.
 
-    8 → first three axes get 2; 4 → first two; 2 → first; any odd
-    remainder is absorbed into ``absorb``.
+    8 → first three axes get 2; 4 → first two; 2 → first.  Any
+    leftover factor — everything beyond one 2 per axis, plus any odd
+    factor — is absorbed into ``absorb`` (the data axis by default:
+    dp tolerates any size, while tp/sp must divide model/sequence
+    dims).  Examples: 16 → dp=4,tp=2,sp=2; 6 → dp=6; 12 → dp=6,tp=2.
+
+    TPU pods are powers of two, where this is exact; for other device
+    counts a warning notes the lopsided absorption so nobody is
+    surprised by dp carrying an odd factor.
     """
+    if not names:
+        raise ValueError("names must be non-empty")
+    if absorb not in names:
+        raise ValueError(f"absorb={absorb!r} is not one of {names}")
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
     axes = {name: 1 for name in names}
     rest = n_devices
     for name in names:
@@ -43,6 +57,11 @@ def factor_mesh_axes(n_devices: int,
             axes[name] = 2
             rest //= 2
     axes[absorb] *= rest
+    if rest > 1 and rest % 2:
+        logging.getLogger("horovod_tpu.training").warning(
+            "factor_mesh_axes: %d devices has odd factor %d, absorbed "
+            "into %r -> %s; pass an explicit axis dict for a different "
+            "layout", n_devices, rest, absorb, axes)
     return axes
 
 
