@@ -77,6 +77,11 @@ class BackgroundRuntime:
         self._fatal_listeners = []
         self._fatal_fired = False
         self._dispatch_disabled = False
+        # Serializes recv-thread direct dispatch against quiesce():
+        # backend.close() must never overlap a running
+        # _perform_operation (the ring backend has its own fusion-lock
+        # serialization, but the XLA mesh backend has none).
+        self._dispatch_lock = threading.Lock()
         if hasattr(self.controller, "set_broken_callback"):
             self.controller.set_broken_callback(self._on_fatal)
 
@@ -187,6 +192,18 @@ class BackgroundRuntime:
         backend."""
         self.stop_background()
         self._dispatch_disabled = True
+        # A dispatch that passed the disabled check before we set it
+        # may still be running on the recv thread; taking the lock
+        # waits it out so the caller can close the backend safely.
+        # Bounded: a dispatch stuck inside a compiled collective whose
+        # peer already quiesced would otherwise hang shutdown forever
+        # (mirror stop_background's join timeout).
+        if self._dispatch_lock.acquire(timeout=10.0):
+            self._dispatch_lock.release()
+        else:
+            logger.warning(
+                "quiesce: in-flight response dispatch did not finish "
+                "within 10s; proceeding with backend teardown")
         self.tensor_queue.shutdown_flush()
 
     def detach(self):
@@ -239,13 +256,14 @@ class BackgroundRuntime:
         Mirrors the background loop's error contract: a failure
         surfaces to future submitters and flushes outstanding
         callbacks."""
-        if self._dispatch_disabled:
-            return  # quiesced: entries already flushed with an error
-        try:
-            self._perform_operation(resp)
-        except Exception as e:
-            logger.exception("response dispatch error")
-            self._on_fatal(e)
+        with self._dispatch_lock:
+            if self._dispatch_disabled:
+                return  # quiesced: entries already flushed with error
+            try:
+                self._perform_operation(resp)
+            except Exception as e:
+                logger.exception("response dispatch error")
+                self._on_fatal(e)
 
     def _run_once(self):
         if self.timeline:

@@ -247,11 +247,21 @@ class RingBackend(Backend):
         serializes direct concurrent callers and lets close() wait out
         any in-flight collective before destroying the C comm (no
         use-after-free).  The allreduce/reducescatter paths hold the
-        lock across their staging too and call the lib directly."""
+        lock across their staging too and call the lib directly via
+        _comm_checked() so a caller that was blocked on the lock while
+        close() ran gets the clean closed error, not a NULL deref in
+        the C ring."""
         with self._fusion_lock:
-            if self._comm is None:
-                raise RuntimeError("ring backend is closed")
-            return fn(self._comm, *args)
+            return fn(self._comm_checked(), *args)
+
+    def _comm_checked(self):
+        """Must be called with _fusion_lock held: close() nulls _comm
+        under the same lock, so a collective that acquired the lock
+        after close() must re-check before handing the pointer to C
+        (hvd_ring_* dereference it unchecked)."""
+        if self._comm is None:
+            raise RuntimeError("ring backend is closed")
+        return self._comm
 
     def close(self):
         if self._comm is not None:
@@ -338,7 +348,8 @@ class RingBackend(Backend):
         if flat.size:
             with self._fusion_lock:      # one collective on the ring
                 rc = self._lib.hvd_ring_allreduce(
-                    self._comm, out.ctypes.data_as(ctypes.c_void_p),
+                    self._comm_checked(),
+                    out.ctypes.data_as(ctypes.c_void_p),
                     flat.size, _DTYPES[dt], _OPS[reduce_op], None, 0)
             if rc != 0:
                 raise RuntimeError(f"ring allreduce failed (rc={rc})")
@@ -390,7 +401,8 @@ class RingBackend(Backend):
             self._scale_inplace(buf, prescale)
             if total:
                 rc = self._lib.hvd_ring_allreduce(
-                    self._comm, buf.ctypes.data_as(ctypes.c_void_p),
+                    self._comm_checked(),
+                    buf.ctypes.data_as(ctypes.c_void_p),
                     total, _DTYPES[work_dt], _OPS[reduce_op],
                     ranks_arr, nranks)
                 if rc != 0:
@@ -599,7 +611,8 @@ class RingBackend(Backend):
                 counts_c = (ctypes.c_longlong * gsize)(*counts)
                 res = np.empty(counts[my_idx], work_dt)
                 rc = self._lib.hvd_ring_reducescatter(
-                    self._comm, buf.ctypes.data_as(ctypes.c_void_p),
+                    self._comm_checked(),
+                    buf.ctypes.data_as(ctypes.c_void_p),
                     counts_c, _DTYPES[work_dt], _OPS[reduce_op],
                     res.ctypes.data_as(ctypes.c_void_p), ranks_arr,
                     nranks)

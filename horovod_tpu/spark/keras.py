@@ -158,11 +158,27 @@ class KerasEstimator(HorovodEstimator):
                 # with surplus batches would otherwise spill them into
                 # keras's next epoch, drifting epoch boundaries (and
                 # the per-epoch reshuffle seed / checkpoint) further
-                # every epoch.
+                # every epoch.  The converse — a pass yielding FEWER
+                # than steps_per_epoch (part files drifted from the
+                # metadata row counts) — must fail loudly: islice
+                # would silently pull the shortfall from the next
+                # pass, drifting epochs/seeds/checkpoints with no
+                # error.
                 e = start_epoch
                 while True:
-                    yield from itertools.islice(
-                        epoch_pass(e, True), steps_per_epoch)
+                    n = 0
+                    for item in itertools.islice(
+                            epoch_pass(e, True), steps_per_epoch):
+                        yield item
+                        n += 1
+                    if n < steps_per_epoch:
+                        raise RuntimeError(
+                            f"rank {rank}: epoch {e} streamed only "
+                            f"{n}/{steps_per_epoch} synced batches "
+                            f"from {store.get_train_data_path()} — "
+                            f"part files no longer match the "
+                            f"metadata row counts (rewritten/lost "
+                            f"part?)")
                     e += 1
 
             cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]
