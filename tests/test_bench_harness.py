@@ -43,46 +43,110 @@ def test_last_tpu_cache_missing_or_corrupt(bench):
     assert bench.load_last_tpu() is None
 
 
-def test_probe_timeout_is_bounded(bench, monkeypatch):
+def test_probe_timeout_is_bounded_and_group_killed(bench, monkeypatch):
     """A probe that hangs (wedged axon claim) must return an error
-    within the timeout, not block; the subprocess is stubbed so the
-    test never touches a real (possibly wedged) TPU tunnel."""
+    within the timeout AND SIGKILL the probe's whole process group —
+    a surviving grandchild would keep the device claim wedged.  The
+    child is a stub that ignores SIGTERM, so only the killpg path can
+    reap it.  Never touches a real (possibly wedged) TPU tunnel."""
     import subprocess as sp
 
-    def fake_run(cmd, capture_output, timeout):
-        assert timeout == 1.5
-        raise sp.TimeoutExpired(cmd, timeout)
+    real_popen = sp.Popen
+    spawned = {}
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
-    info, err = bench.probe_tpu(timeout_s=1.5)
+    def fake_popen(cmd, **kw):
+        assert kw.get("start_new_session"), \
+            "probe child must own its process group"
+        p = real_popen(
+            [sys.executable, "-c",
+             "import signal, time; "
+             "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+             "time.sleep(60)"], **kw)
+        spawned["proc"] = p
+        return p
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    info, err, diag = bench.probe_tpu(timeout_s=1.0, attempts=1)
     assert info is None
     assert "timed out" in err
+    assert spawned["proc"].returncode is not None  # reaped, not leaked
+    assert diag["attempts"][0]["error"] == err
+
+
+def test_probe_retries_and_full_output(bench, monkeypatch):
+    """All attempts' FULL child output must land in the diagnostics —
+    round 4's 300-char tail made 'wedged claim' vs 'server outage'
+    undecidable from the artifact."""
+    calls = []
+
+    def fake_probe_once(timeout_s):
+        calls.append(timeout_s)
+        if len(calls) < 3:
+            return None, "TPU probe failed (rc=1)", "boom %d" % len(calls)
+        return {"platform": "tpu", "kind": "TPU v5e"}, None, "PROBE ok"
+
+    monkeypatch.setattr(bench, "_probe_once", fake_probe_once)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    info, err, diag = bench.probe_tpu(timeout_s=5, attempts=3)
+    assert err is None
+    assert info == {"platform": "tpu", "kind": "TPU v5e"}
+    assert len(diag["attempts"]) == 3
+    assert diag["attempts"][0]["child_output"] == "boom 1"
+    assert diag["attempts"][1]["child_output"] == "boom 2"
 
 
 def test_probe_clean_cpu_is_not_an_outage(bench, monkeypatch):
     """A host with no TPU at all answers cleanly with CPU devices;
     that must NOT be reported as a tunnel outage (which would downgrade
     full-size CPU benches to smoke and attach stale TPU evidence)."""
-    class FakeCompleted:
-        returncode = 0
-        stdout = b'PROBE {"platform": "cpu", "kind": "cpu"}\n'
-        stderr = b""
-
-    monkeypatch.setattr(bench.subprocess, "run",
-                        lambda *a, **k: FakeCompleted())
-    info, err = bench.probe_tpu(timeout_s=5)
+    monkeypatch.setattr(
+        bench, "_probe_once",
+        lambda t: ({"platform": "cpu", "kind": "cpu"}, None, "PROBE"))
+    info, err, diag = bench.probe_tpu(timeout_s=5, attempts=3)
     assert err is None
     assert info["platform"] == "cpu"
+    assert len(diag["attempts"]) == 1  # success: no pointless retries
 
 
-def test_probe_accepts_tpu(bench, monkeypatch):
-    class FakeCompleted:
-        returncode = 0
-        stdout = b'PROBE {"platform": "tpu", "kind": "TPU v5e"}\n'
-        stderr = b""
+def test_probe_once_parses_real_child(bench, monkeypatch):
+    """_probe_once against a real benign child (no jax import)."""
+    import subprocess as sp
 
-    monkeypatch.setattr(bench.subprocess, "run",
-                        lambda *a, **k: FakeCompleted())
-    info, err = bench.probe_tpu(timeout_s=5)
+    real_popen = sp.Popen
+
+    def fake_popen(cmd, **kw):
+        return real_popen(
+            [sys.executable, "-c",
+             "print('PROBE {\"platform\": \"tpu\", "
+             "\"kind\": \"TPU v5e\"}')"], **kw)
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    info, err, txt = bench._probe_once(timeout_s=30)
     assert err is None
     assert info == {"platform": "tpu", "kind": "TPU v5e"}
+    assert "PROBE" in txt
+
+
+def test_probe_total_wall_cap(bench, monkeypatch):
+    """Against a persistent wedge every timed-out attempt costs its
+    full timeout; the total cap must stop retrying before the probe
+    eats the bench budget."""
+    clock = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "time", lambda: clock["t"])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__("t", clock["t"] + s))
+
+    def fake_probe_once(timeout_s):
+        clock["t"] += timeout_s
+        return None, "TPU probe timed out after %.0fs (wedged device " \
+            "claim?)" % timeout_s, ""
+
+    monkeypatch.setattr(bench, "_probe_once", fake_probe_once)
+    monkeypatch.setenv("HOROVOD_BENCH_TPU_PROBE_TOTAL", "300")
+    info, err, diag = bench.probe_tpu(timeout_s=120, attempts=3,
+                                      backoff_s=45)
+    assert info is None and "timed out" in err
+    # 120 + (45 backoff + 120) = 285 <= 300; a third attempt would
+    # need 90 + 120 more and is capped.
+    assert len(diag["attempts"]) == 2
+    assert diag.get("capped") is True
