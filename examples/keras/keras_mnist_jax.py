@@ -8,9 +8,14 @@ gradients from inside that compiled step (``io_callback`` into the
 fused collective data plane — on TPU, XLA collectives over ICI).  No
 TensorFlow, no py_function, no per-op host staging of activations.
 
-Run (one rank per chip):
+Run (one rank per chip, eager gradient plane):
       KERAS_BACKEND=jax horovodrun -np 2 -H localhost:2 \\
           python keras_mnist_jax.py --epochs 1
+IN-GRAPH gradient plane (recommended on TPU — one SPMD program over
+every chip of every rank; gradients reduced by XLA collectives inside
+the compiled step, never staged through the host):
+      KERAS_BACKEND=jax horovodrun -np 2 ... \\
+          python keras_mnist_jax.py --in-graph
 Single TPU host (8 chips, pure XLA data parallelism, ONE process):
       KERAS_BACKEND=jax python keras_mnist_jax.py --data-parallel
 """
@@ -40,6 +45,11 @@ def main():
                              "keras.distribution.DataParallel "
                              "(single-host multi-chip without any "
                              "worker processes).")
+    parser.add_argument("--in-graph", action="store_true",
+                        help="hvd.set_data_parallel(): one SPMD train "
+                             "step over every chip of every rank; "
+                             "the gradient all-reduce is compiled "
+                             "into the step (no host staging).")
     args = parser.parse_args()
 
     assert keras.backend.backend() == "jax", (
@@ -60,7 +70,13 @@ def main():
     if args.data_parallel and hvd.size() > 1:
         raise SystemExit(
             "--data-parallel is the single-process mode; for "
-            f"size={hvd.size()} launch one rank per chip instead")
+            f"size={hvd.size()} use --in-graph (SPMD over all ranks' "
+            "chips) or launch one rank per chip")
+    if args.in_graph:
+        # Must run BEFORE the model is built: variables are laid out
+        # (replicated) over the global mesh at creation, and rank 0's
+        # seed is broadcast so every rank initializes identically.
+        hvd.set_data_parallel()
 
     if args.synthetic:
         x_train = np.random.rand(4096, 28, 28, 1).astype("float32")
@@ -107,7 +123,11 @@ def main():
     if hvd.rank() == 0:
         print(f"param device: {sorted(d.platform for d in v.devices())}"
               f" backend={keras.backend.backend()}")
-        model.save("mnist_model_jax.keras")
+        # Rank-local variable creation (keras's save path instantiates
+        # a throwaway optimizer) must not run under the global
+        # distribution — see hvd.rank_local().
+        with hvd.rank_local():
+            model.save("mnist_model_jax.keras")
         print("done")
 
 

@@ -33,19 +33,63 @@ def _scales(op, gradient_predivide_factor, process_set):
     return 1.0, 1.0, op
 
 
+def _active_distribution_scope():
+    """Classify the active keras distribution for gradient-sync
+    purposes.  Returns one of:
+
+    * ``"global"`` — a distribution whose device mesh spans EVERY jax
+      process: the jit-compiled train step is one SPMD program over
+      the whole job and XLA already inserted the gradient all-reduce
+      (ICI/DCN) during partitioning.  Gradient sync is the identity;
+      gradients never leave the accelerators (the property of the
+      reference's NCCL path, nccl_operations.cc:126-184, achieved by
+      fusing the collective INTO the step).
+    * ``"local"`` — a distribution over this process's devices only
+      while the world has size > 1: the step is multi-device (ordered
+      io_callback cannot lower) but replicas on OTHER processes see
+      none of it — unsupported; the caller raises with guidance.
+    * ``None`` — no distribution: keras jits on one local device and
+      the io_callback eager plane applies.
+    """
+    try:
+        from keras import distribution as kd
+        dist = kd.distribution()
+    except Exception:
+        return None
+    if dist is None:
+        return None
+    try:
+        devs = list(dist.device_mesh.devices.flatten())
+    except Exception:
+        return None
+    import jax
+    procs = {getattr(d, "process_index", 0) for d in devs}
+    if len(procs) >= jax.process_count():
+        return "global"
+    return "local"
+
+
 def _jax_grads_fn(compression, op, gradient_predivide_factor,
                   process_set):
     """Gradient reduction for the Keras-3 JAX backend.
 
-    Keras's JAX trainer jit-compiles the whole train step and calls
-    ``optimizer.stateless_apply`` INSIDE the traced program, so the
-    reduction must be traceable: ``jax.experimental.io_callback``
-    suspends the compiled step, runs the grouped allreduce on the
-    eager data plane (on TPU that is the fused XLA collective over
-    ICI — the same structure as the reference's GPU-compute +
-    NCCL-enqueue split, tensorflow/mpi_ops.cc:374-428), and resumes
-    on-chip.  ``ordered=True`` keeps the per-rank submission order
-    identical, which the coordinator's fusion relies on."""
+    Two planes, chosen per call (so ``hvd.keras.set_data_parallel``
+    may run before or after optimizer creation):
+
+    * **In-graph (preferred on TPU)** — with a keras distribution
+      spanning the whole job (``set_data_parallel``), gradients are
+      reduced by XLA-inserted collectives inside the compiled SPMD
+      step; this function is the identity there.
+    * **Eager io_callback** — without a distribution, keras's JAX
+      trainer jit-compiles the train step on ONE local device and
+      calls ``optimizer.stateless_apply`` inside the traced program;
+      ``jax.experimental.io_callback`` suspends the compiled step,
+      runs the grouped allreduce on the eager data plane (on TPU the
+      fused XLA collective over ICI — the same structure as the
+      reference's GPU-compute + NCCL-enqueue split,
+      tensorflow/mpi_ops.cc:374-428), and resumes on-chip.
+      ``ordered=True`` keeps the per-rank submission order identical,
+      which the coordinator's fusion relies on."""
     import jax
     from jax.experimental import io_callback
 
@@ -68,6 +112,8 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
             np.ascontiguousarray(compression.decompress(r, ctx))
             for r, ctx in zip(reduced, ctxs))
 
+    warned_idle = []
+
     def allreduce_grads(grads, variables=None):
         grads = list(grads)
         index = [i for i, g in enumerate(grads) if g is not None]
@@ -78,26 +124,52 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
         static_single = (process_set.size() == 1 and
                          not basics._state().knobs.elastic)
         if not index or static_single:
+            # Size-1 non-elastic worlds sync nothing, whatever knobs
+            # or local keras distribution are in play — keep the
+            # pre-round-5 behavior where eager-plane knobs are
+            # harmless no-ops there.
             return grads
-        if jax.local_device_count() > 1:
-            # ordered io_callback cannot lower into a multi-device
-            # computation (and per-shard callback fan-out would desync
-            # the coordinator's counts).
+        scope = _active_distribution_scope()
+        if scope == "global":
+            # One SPMD program over every chip in the job: XLA already
+            # reduced the gradients in-graph.  Knobs that only make
+            # sense on the eager wire cannot apply here.
+            if compression is not Compression.none or \
+                    gradient_predivide_factor != 1.0 or op != Average:
+                # The SPMD program computes the global-batch MEAN
+                # gradient (= Average); Sum/compression/predivide are
+                # eager-wire semantics with no in-graph counterpart.
+                raise ValueError(
+                    "compression / gradient_predivide_factor / "
+                    "op=%r are eager-plane options and do not apply "
+                    "to the in-graph data-parallel plane installed by "
+                    "hvd.keras.set_data_parallel(); remove them or "
+                    "drop the keras distribution." % (op,))
+            if process_set is not global_process_set:
+                raise ValueError(
+                    "process_set sub-worlds are not supported with "
+                    "the in-graph keras distribution (the SPMD "
+                    "program spans the whole job)")
+            return grads
+        if scope == "local":
             raise NotImplementedError(
-                "hvd.DistributedOptimizer on the Keras JAX backend "
-                "needs exactly one visible device per process when "
-                f"size > 1; this rank sees "
-                f"{jax.local_device_count()}. Supported topologies: "
-                "(a) processes that each own one chip — multi-host "
-                "pods where workers are per-chip VMs, or hosts where "
-                "the operator pins chips per process via the TPU "
-                "runtime env (TPU_VISIBLE_CHIPS et al.) / "
-                "CUDA_VISIBLE_DEVICES / "
-                "XLA_FLAGS=--xla_force_host_platform_device_count=1; "
-                "(b) a SINGLE process using "
-                "keras.distribution.DataParallel over its local "
-                "chips; (c) horovod_tpu.training's sharded trainers "
-                "for pod-scale meshes.")
+                "A keras distribution over this process's local "
+                "devices only cannot be combined with size > 1: the "
+                "multi-device train step cannot suspend into the "
+                "eager collective plane (ordered io_callback), and "
+                "other ranks' replicas would desync.  Use "
+                "hvd.keras.set_data_parallel() AFTER hvd.init() to "
+                "span the whole job in-graph instead.")
+        if jax.local_device_count() > 1 and not warned_idle:
+            warned_idle.append(True)
+            import warnings
+            warnings.warn(
+                "hvd.DistributedOptimizer (Keras JAX backend): this "
+                f"process sees {jax.local_device_count()} devices but "
+                "keras compiles on one; the rest idle. Call "
+                "hvd.keras.set_data_parallel() after hvd.init() to "
+                "train one in-graph SPMD program over every chip.",
+                stacklevel=3)
         flat = [grads[i] for i in index]
         shapes = tuple(jax.ShapeDtypeStruct(g.shape, g.dtype)
                        for g in flat)

@@ -35,7 +35,89 @@ __all__ = [
     "DistributedOptimizer", "broadcast_global_variables",
     "broadcast_variables", "broadcast_model", "allreduce", "allgather",
     "broadcast", "callbacks", "elastic", "load_model",
+    "set_data_parallel", "rank_local",
 ]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def rank_local():
+    """Temporarily deactivate the global keras distribution for
+    RANK-LOCAL work that creates keras variables.
+
+    Under a multi-host distribution (``set_data_parallel``), creating
+    any keras variable is a COLLECTIVE: the initial value is
+    device_put onto the global mesh and jax asserts it equal across
+    processes.  Keras's saving machinery instantiates a throwaway
+    optimizer (and with it an ``iterations`` variable) inside
+    ``model.save`` — so a bare ``if hvd.rank() == 0: model.save(...)``
+    deadlocks the job with every other rank absent from the
+    collective.  Wrap rank-local save/checkpoint work instead::
+
+        if hvd.rank() == 0:
+            with hvd.rank_local():
+                model.save(path)
+
+    Reading weights is safe either way (replicated arrays are locally
+    addressable); only variable CREATION is collective.
+    """
+    from keras import distribution as kd
+    dist = kd.distribution()
+    kd.set_distribution(None)
+    try:
+        yield
+    finally:
+        kd.set_distribution(dist)
+
+
+def set_data_parallel(seed=None, devices=None):
+    """Install the in-graph data-parallel gradient plane for the Keras
+    JAX backend: one SPMD train step over EVERY chip of every rank.
+
+    TPU-first alternative to the eager per-step gradient hop: with
+    this active, ``model.fit`` jit-compiles a single program over the
+    global device mesh, XLA inserts the gradient all-reduce during
+    SPMD partitioning (riding ICI within a slice, DCN across), and
+    gradients never leave the accelerators — the property the
+    reference gets from on-device NCCL buffers
+    (common/ops/nccl_operations.cc:126-184), achieved here by fusing
+    the collective INTO the compiled step.  ``DistributedOptimizer``
+    detects the active global distribution and skips its own eager
+    reduction.
+
+    Usage (per rank, horovod conventions throughout)::
+
+        hvd.init()
+        hvd.set_data_parallel()          # BEFORE building the model
+        model = ...                      # each rank builds identically
+        model.compile(optimizer=hvd.DistributedOptimizer(opt), ...)
+        model.fit(my_rank_shard, ...)    # each rank feeds its shard
+
+    Ranks must create identical variables: rank 0's ``seed`` is
+    broadcast and applied via ``keras.utils.set_random_seed`` before
+    any variable exists (multi-host jax asserts initial values match).
+    Auto-sharding is disabled — each rank feeds its OWN data shard,
+    exactly like every other horovod binding.
+
+    Returns the installed ``keras.distribution.DataParallel``.
+    """
+    import numpy as np
+    import jax
+    from keras import distribution as kd
+    from ..common.basics import _state
+    _state().require_init()
+    if seed is None:
+        seed = int(np.random.randint(0, 2 ** 31 - 1))
+    seed = int(np.asarray(_ops.broadcast(
+        np.array([seed], np.int64), 0, name="keras.dp.seed"))[0])
+    keras.utils.set_random_seed(seed)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    mesh = kd.DeviceMesh((len(devs),), ["batch"], devices=devs)
+    dp = kd.DataParallel(device_mesh=mesh, auto_shard_dataset=False)
+    kd.set_distribution(dp)
+    return dp
 
 
 def DistributedOptimizer(optimizer, name=None,

@@ -100,3 +100,158 @@ def test_keras_jax_single_process():
                           extra_env={"KERAS_BACKEND": "jax"})
     assert_all_ok(results)
     assert all("KERAS-JAX-SINGLE-OK" in out for _, out in results)
+
+
+_SPMD_BODY = """
+import os
+import keras
+assert keras.backend.backend() == "jax"
+import jax
+import horovod_tpu.keras as hvd
+from horovod_tpu.common import basics
+
+hvd.init()
+assert jax.local_device_count() == 4, jax.local_device_count()
+assert len(jax.devices()) == 4 * SIZE
+
+hvd.set_data_parallel(seed=1234)
+
+# Rank-disjoint shards: convergence to the shared global least-squares
+# solution proves the gradient all-reduce happened — and with the
+# in-graph plane it must happen INSIDE the compiled SPMD step, not on
+# the eager wire.
+x = (np.linspace(0, 1, 512)[RANK::SIZE]).astype("float32")[:, None]
+y = 2.0 * x + 0.5
+
+model = keras.Sequential([keras.layers.Input((1,)),
+                          keras.layers.Dense(1)])
+opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.4))
+model.compile(optimizer=opt, loss="mse")
+
+before = dict(basics._state().runtime.controller.stats)
+hist = model.fit(x, y, batch_size=64, epochs=30, verbose=0)
+after = dict(basics._state().runtime.controller.stats)
+
+# 1. The eager control plane saw (almost) NO traffic during fit: the
+#    gradient sync is in-graph.  (set_data_parallel's seed broadcast
+#    happened before `before` was sampled; allow a tiny slack for
+#    stray control frames.)
+frames = (after.get("ch_frames", 0) + after.get("rq_frames", 0)) - \
+         (before.get("ch_frames", 0) + before.get("rq_frames", 0))
+assert frames <= 4, (before, after)
+
+# 2. Params are GLOBAL jax arrays spanning every process's devices
+#    (replicated by the DataParallel layout) — gradients reduced on
+#    device, never staged through host numpy.
+val = model.layers[-1].kernel.value
+assert isinstance(val, jax.Array)
+assert len(val.sharding.device_set) == 4 * SIZE, val.sharding
+
+# 3. Both ranks converged to the GLOBAL solution.
+w = float(model.layers[-1].kernel.value[0, 0])
+b = float(model.layers[-1].bias.value[0])
+assert abs(w - 2.0) < 0.1 and abs(b - 0.5) < 0.1, (w, b)
+assert hist.history["loss"][-1] < 1e-3, hist.history["loss"][-1]
+
+# 4. Rank-local save: keras's save path CREATES a variable (throwaway
+#    optimizer), which under the global distribution is a collective —
+#    hvd.rank_local() must make a rank-0-only save safe.
+if RANK == 0:
+    import tempfile
+    with hvd.rank_local():
+        model.save(os.path.join(tempfile.mkdtemp(), "m.keras"))
+print("KERAS-JAX-SPMD-OK", round(w, 3), round(b, 3))
+"""
+
+
+def test_keras_jax_spmd_multiproc_multidevice():
+    """VERDICT r4 items 3+4: size>1 x several local devices per
+    process, gradient plane in-graph (no host staging, no io_callback
+    refusal)."""
+    results = run_workers(
+        _SPMD_BODY, nproc=2, timeout=360,
+        extra_env={"KERAS_BACKEND": "jax",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=4"})
+    assert_all_ok(results)
+    assert all("KERAS-JAX-SPMD-OK" in out for _, out in results)
+
+
+_MULTIDEV_NODIST_BODY = """
+import os, warnings
+import keras
+import jax
+import horovod_tpu.keras as hvd
+
+hvd.init()
+assert jax.local_device_count() == 4
+
+# No keras distribution: the train step compiles on ONE local device,
+# so the eager io_callback plane applies (round 4 refused this
+# topology outright; it is legal, just wasteful — expect the idle-chip
+# warning pointing at set_data_parallel).
+x = (np.linspace(0, 1, 256)[RANK::SIZE]).astype("float32")[:, None]
+y = 2.0 * x + 0.5
+model = keras.Sequential([keras.layers.Input((1,)),
+                          keras.layers.Dense(1)])
+opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.4))
+model.compile(optimizer=opt, loss="mse")
+cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]
+with warnings.catch_warnings(record=True) as caught:
+    warnings.simplefilter("always")
+    model.fit(x, y, batch_size=32, epochs=30, callbacks=cbs, verbose=0)
+assert any("set_data_parallel" in str(c.message) for c in caught), \
+    [str(c.message) for c in caught]
+w = float(model.layers[-1].kernel.value[0, 0])
+b = float(model.layers[-1].bias.value[0])
+assert abs(w - 2.0) < 0.1 and abs(b - 0.5) < 0.1, (w, b)
+print("KERAS-JAX-NODIST-OK")
+"""
+
+
+def test_keras_jax_multidevice_without_distribution_falls_back():
+    results = run_workers(
+        _MULTIDEV_NODIST_BODY, nproc=2, timeout=360,
+        extra_env={"KERAS_BACKEND": "jax",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=4"})
+    assert_all_ok(results)
+    assert all("KERAS-JAX-NODIST-OK" in out for _, out in results)
+
+
+_LOCAL_DIST_BODY = """
+import os
+import keras
+from keras import distribution as kd
+import jax
+import horovod_tpu.keras as hvd
+
+hvd.init()
+local = jax.local_devices()
+mesh = kd.DeviceMesh((len(local),), ["batch"], devices=local)
+kd.set_distribution(kd.DataParallel(device_mesh=mesh,
+                                    auto_shard_dataset=False))
+x = np.random.rand(64, 1).astype("float32")
+y = 2 * x
+model = keras.Sequential([keras.layers.Input((1,)),
+                          keras.layers.Dense(1)])
+opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+model.compile(optimizer=opt, loss="mse")
+try:
+    model.fit(x, y, batch_size=32, epochs=1, verbose=0)
+    raise SystemExit("local-only distribution with size>1 must raise")
+except NotImplementedError as e:
+    assert "set_data_parallel" in str(e), e
+print("KERAS-JAX-LOCALDIST-RAISES-OK")
+"""
+
+
+def test_keras_jax_local_distribution_with_world_raises():
+    results = run_workers(
+        _LOCAL_DIST_BODY, nproc=2, timeout=300,
+        extra_env={"KERAS_BACKEND": "jax",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=4"})
+    assert_all_ok(results)
+    assert all("KERAS-JAX-LOCALDIST-RAISES-OK" in out
+               for _, out in results)
