@@ -87,6 +87,24 @@ def construct_response(name: str, msgs: List[Request], size: int,
             err = (f"Mismatched non-first dimensions for tensor {name}.")
             break
 
+    if err is None and first.request_type == RequestType.ALLTOALL:
+        group = len(first.process_set_ranks) or size
+        for m in msgs:
+            # 0-d tensors are promoted to one row by the data plane
+            # (same convention as the allgather sizes above).
+            dim0 = m.tensor_shape[0] if m.tensor_shape else 1
+            if len(m.splits) != group:
+                err = (f"Alltoall splits for tensor {name}: rank "
+                       f"{m.request_rank} sent {len(m.splits)} entries "
+                       f"for a group of {group}.")
+                break
+            if any(s < 0 for s in m.splits) or sum(m.splits) != dim0:
+                err = (f"Alltoall splits for tensor {name}: rank "
+                       f"{m.request_rank} splits {list(m.splits)} must "
+                       f"be non-negative and sum to the first "
+                       f"dimension ({dim0}).")
+                break
+
     if err is not None:
         return Response(response_type=ResponseType.ERROR,
                         tensor_names=[name], error_message=err,
@@ -120,6 +138,23 @@ def construct_response(name: str, msgs: List[Request], size: int,
             else:
                 sizes.append(0)
         resp.tensor_sizes = sizes
+    elif first.request_type == RequestType.ALLTOALL:
+        # Flattened group×group send-split matrix, rows in GROUP order
+        # (row g = group-rank g's send splits): rank g's recv splits
+        # are column g.  Piggybacked on negotiation so the data plane
+        # never needs its own split-exchange collective (reference:
+        # AlltoallGetRecvSplits, mpi_controller.cc:212-223).  Joined
+        # (departed) ranks contribute zero rows.
+        by_rank = {m.request_rank: m for m in msgs}
+        ranks = list(first.process_set_ranks) or list(range(size))
+        group = len(ranks)
+        matrix = []
+        for r in ranks:
+            if r in by_rank:
+                matrix.extend(int(s) for s in by_rank[r].splits)
+            else:
+                matrix.extend([0] * group)
+        resp.tensor_sizes = matrix
     return resp
 
 

@@ -117,6 +117,13 @@ class Request:
     # kept atomic by the fusion planner even past the fusion threshold
     # (reference: group_table.{h,cc}, controller.cc:199-223).
     group_id: int = -1
+    # Alltoall send splits (dim-0 rows per destination, group order).
+    # Carried on the wire so the coordinator can assemble every rank's
+    # recv splits into the Response — saving the data plane a full
+    # allgather round per uneven alltoall (reference:
+    # AlltoallGetRecvSplits, mpi_controller.cc:212-223, which
+    # piggybacks the split exchange on negotiation the same way).
+    splits: Tuple[int, ...] = ()
 
     def nbytes(self) -> int:
         n = 1
@@ -129,21 +136,24 @@ class Request:
         op_b = self.reduce_op.encode()
         shape = self.tensor_shape
         psr = self.process_set_ranks
+        spl = self.splits
         head = struct.pack(
-            "<iiiiiddiiiHHH", self.request_rank, int(self.request_type),
+            "<iiiiiddiiiHHHH", self.request_rank, int(self.request_type),
             int(self.tensor_type), self.root_rank, self.device,
             self.prescale_factor, self.postscale_factor,
             self.process_set_id, self.group_id, len(shape), len(name_b),
-            len(op_b), len(psr))
+            len(op_b), len(psr), len(spl))
         return (head + struct.pack(f"<{len(shape)}q", *shape) + name_b +
-                op_b + struct.pack(f"<{len(psr)}i", *psr))
+                op_b + struct.pack(f"<{len(psr)}i", *psr) +
+                struct.pack(f"<{len(spl)}q", *spl))
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Request":
-        head_fmt = "<iiiiiddiiiHHH"
+        head_fmt = "<iiiiiddiiiHHHH"
         head_size = struct.calcsize(head_fmt)
         (rank, rtype, dtype, root, device, pre, post, psid, group_id,
-         ndim, name_len, op_len, n_psr) = struct.unpack_from(head_fmt, data)
+         ndim, name_len, op_len, n_psr,
+         n_spl) = struct.unpack_from(head_fmt, data)
         off = head_size
         shape = struct.unpack_from(f"<{ndim}q", data, off)
         off += 8 * ndim
@@ -152,12 +162,15 @@ class Request:
         op = data[off:off + op_len].decode()
         off += op_len
         psr = struct.unpack_from(f"<{n_psr}i", data, off)
+        off += 4 * n_psr
+        spl = struct.unpack_from(f"<{n_spl}q", data, off)
         return cls(request_rank=rank, request_type=RequestType(rtype),
                    tensor_name=name, tensor_shape=tuple(shape),
                    tensor_type=DataType(dtype), root_rank=root,
                    device=device, prescale_factor=pre, postscale_factor=post,
                    process_set_id=psid, reduce_op=op,
-                   process_set_ranks=tuple(psr), group_id=group_id)
+                   process_set_ranks=tuple(psr), group_id=group_id,
+                   splits=tuple(spl))
 
 
 @dataclass

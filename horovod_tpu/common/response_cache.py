@@ -44,10 +44,14 @@ from .message import Request, RequestType, Response, ResponseType
 
 # Response types that participate in the cache (JOIN/BARRIER/ERROR are
 # control-flow, never cached — reference response_cache.cc caches the
-# data collectives only).
+# data collectives only).  ALLTOALL is excluded since round 5: its
+# response carries the send-split matrix, and splits may legally change
+# call-to-call under an unchanged signature — a cached response would
+# serve stale recv splits.  Full negotiation per alltoall is still one
+# round cheaper than the pre-round-5 CH + data-plane split-allgather.
 CACHEABLE = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
              ResponseType.ALLGATHER, ResponseType.BROADCAST,
-             ResponseType.ALLTOALL, ResponseType.REDUCESCATTER}
+             ResponseType.REDUCESCATTER}
 
 _RESP_TO_REQ = {
     ResponseType.ALLREDUCE: RequestType.ALLREDUCE,

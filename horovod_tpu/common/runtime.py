@@ -369,10 +369,16 @@ class BackgroundRuntime:
                     [e.tensor for e in entries], resp.root_rank,
                     ps_ranks)
             elif resp.response_type == ResponseType.ALLTOALL:
+                # tensor_sizes carries the coordinator-assembled
+                # group×group send-split matrix (one alltoall per
+                # response — the type is never fused), so the backend
+                # skips its own split-exchange collective.
                 results = []
+                matrix = resp.tensor_sizes or None
                 for e in entries:
                     out, recv_splits = backend.alltoall(
-                        e.tensor, e.splits, ps_ranks)
+                        e.tensor, e.splits, ps_ranks,
+                        split_matrix=matrix)
                     results.append((out, recv_splits))
             elif resp.response_type == ResponseType.REDUCESCATTER:
                 results = backend.reducescatter(

@@ -89,6 +89,11 @@ struct Request {
   std::string name;
   std::string op;
   std::vector<int32_t> psr;  // process-set member ranks
+  // Alltoall send splits (group order) — assembled into the response's
+  // sizes matrix so the data plane skips its own split exchange
+  // (mirrors message.py Request.splits; reference
+  // AlltoallGetRecvSplits, mpi_controller.cc:212-223).
+  std::vector<int64_t> splits;
 };
 
 struct Response {
@@ -147,8 +152,8 @@ class Writer {
 };
 
 bool parse_request(const uint8_t* d, size_t n, Request* r) {
-  // head "<iiiiiddiiiHHH" = 54 bytes
-  if (n < 54) return false;
+  // head "<iiiiiddiiiHHHH" = 56 bytes
+  if (n < 56) return false;
   Reader rd(d, n);
   r->rank = rd.get<int32_t>();
   r->type = rd.get<int32_t>();
@@ -163,7 +168,9 @@ bool parse_request(const uint8_t* d, size_t n, Request* r) {
   uint16_t name_len = rd.get<uint16_t>();
   uint16_t op_len = rd.get<uint16_t>();
   uint16_t n_psr = rd.get<uint16_t>();
-  if (!rd.ok(size_t(ndim) * 8 + name_len + op_len + size_t(n_psr) * 4))
+  uint16_t n_splits = rd.get<uint16_t>();
+  if (!rd.ok(size_t(ndim) * 8 + name_len + op_len +
+             size_t(n_psr) * 4 + size_t(n_splits) * 8))
     return false;
   r->shape.resize(ndim);
   for (int i = 0; i < ndim; ++i) r->shape[i] = rd.get<int64_t>();
@@ -171,6 +178,8 @@ bool parse_request(const uint8_t* d, size_t n, Request* r) {
   r->op = rd.str(op_len);
   r->psr.resize(n_psr);
   for (int i = 0; i < n_psr; ++i) r->psr[i] = rd.get<int32_t>();
+  r->splits.resize(n_splits);
+  for (int i = 0; i < n_splits; ++i) r->splits[i] = rd.get<int64_t>();
   return true;
 }
 
@@ -301,9 +310,13 @@ bool recv_frame(int fd, char magic[2], std::vector<uint8_t>* payload) {
 // ---------------------------------------------------------------------
 const std::set<int32_t> kFusable = {RESP_ALLREDUCE, RESP_ADASUM,
                                     RESP_ALLGATHER, RESP_REDUCESCATTER};
+// ALLTOALL is excluded (round 5): its response carries the send-split
+// matrix, and splits may change call-to-call under an unchanged
+// signature — a cached response would serve stale recv splits
+// (mirrors response_cache.py CACHEABLE).
 const std::set<int32_t> kCacheable = {RESP_ALLREDUCE, RESP_ADASUM,
                                       RESP_ALLGATHER, RESP_BROADCAST,
-                                      RESP_ALLTOALL, RESP_REDUCESCATTER};
+                                      RESP_REDUCESCATTER};
 
 Response construct_response(const std::string& name,
                             const std::vector<Request>& msgs, int size) {
@@ -335,6 +348,30 @@ Response construct_response(const std::string& name,
            !std::equal(m.shape.begin() + 1, m.shape.end(),
                        first.shape.begin() + 1)))
         err = "Mismatched non-first dimensions for tensor " + name + ".";
+    }
+  }
+  if (err.empty() && first.type == REQ_ALLTOALL) {
+    size_t group = first.psr.empty() ? size_t(size) : first.psr.size();
+    for (const auto& m : msgs) {
+      // 0-d tensors are promoted to one row by the data plane.
+      int64_t dim0 = m.shape.empty() ? 1 : m.shape[0];
+      if (m.splits.size() != group) {
+        err = "Alltoall splits for tensor " + name + ": rank " +
+              std::to_string(m.rank) + " sent " +
+              std::to_string(m.splits.size()) + " entries for a group "
+              "of " + std::to_string(group) + ".";
+        break;
+      }
+      int64_t sum = 0;
+      bool neg = false;
+      for (int64_t s : m.splits) { sum += s; neg = neg || s < 0; }
+      if (neg || sum != dim0) {
+        err = "Alltoall splits for tensor " + name + ": rank " +
+              std::to_string(m.rank) + " splits must be non-negative "
+              "and sum to the first dimension (" +
+              std::to_string(dim0) + ").";
+        break;
+      }
     }
   }
   if (!err.empty()) {
@@ -374,6 +411,25 @@ Response construct_response(const std::string& name,
         r.sizes.push_back(sh.empty() ? 1 : sh[0]);
       } else {
         r.sizes.push_back(0);  // joined (departed) rank: zero rows
+      }
+    }
+  } else if (first.type == REQ_ALLTOALL) {
+    // Flattened group×group send-split matrix, rows in GROUP order —
+    // rank g's recv splits are column g (mirrors controller.py;
+    // reference AlltoallGetRecvSplits, mpi_controller.cc:212-223).
+    std::map<int32_t, const Request*> by_rank;
+    for (const auto& m : msgs) by_rank[m.rank] = &m;
+    std::vector<int32_t> ranks;
+    if (!first.psr.empty())
+      ranks.assign(first.psr.begin(), first.psr.end());
+    else
+      for (int rk = 0; rk < size; ++rk) ranks.push_back(rk);
+    for (int rk : ranks) {
+      auto it = by_rank.find(rk);
+      if (it != by_rank.end()) {
+        for (int64_t s : it->second->splits) r.sizes.push_back(s);
+      } else {
+        for (size_t i = 0; i < ranks.size(); ++i) r.sizes.push_back(0);
       }
     }
   }

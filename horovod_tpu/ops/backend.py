@@ -76,7 +76,11 @@ class Backend:
                   ps_ranks=()) -> List[Any]:
         raise NotImplementedError
 
-    def alltoall(self, array, splits, ps_ranks=()) -> Tuple[Any, Any]:
+    def alltoall(self, array, splits, ps_ranks=(),
+                 split_matrix=None) -> Tuple[Any, Any]:
+        """``split_matrix``: optional flattened group×group send-split
+        matrix assembled by the coordinator (rows in group order);
+        when given the backend must not run its own split exchange."""
         raise NotImplementedError
 
     def reducescatter(self, arrays: List[Any], reduce_op: str,
@@ -118,7 +122,7 @@ class SingleProcessBackend(Backend):
     def broadcast(self, arrays, root_rank, ps_ranks=()):
         return list(arrays)
 
-    def alltoall(self, array, splits, ps_ranks=()):
+    def alltoall(self, array, splits, ps_ranks=(), split_matrix=None):
         if splits is None:
             return array, None
         recv_splits = np.asarray(splits)

@@ -101,6 +101,18 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
         tensor_name=name, tensor=tensor,
         callback=handle._complete, root_rank=root_rank,
         process_set_id=process_set.process_set_id, splits=splits)
+    wire_splits = ()
+    if request_type == RequestType.ALLTOALL:
+        # Send splits ride the request so the coordinator can hand every
+        # rank its recv splits in the response (no data-plane split
+        # exchange).  splits=None means an even dim-0 split.
+        if splits is None:
+            from .backend import even_row_counts
+            dim0 = tuple(getattr(tensor, "shape", ()) or (1,))[0]
+            wire_splits = tuple(
+                even_row_counts(int(dim0), process_set.size()))
+        else:
+            wire_splits = tuple(int(s) for s in splits)
     req = Request(
         request_rank=basics.rank(),
         request_type=request_type,
@@ -113,6 +125,7 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
         process_set_id=process_set.process_set_id,
         reduce_op=reduce_op,
         process_set_ranks=tuple(process_set.ranks or ()),
+        splits=wire_splits,
     )
     runtime.submit(req, entry)
     return handle

@@ -146,18 +146,33 @@ class RingBackend(Backend):
         # first op. Every rank walks the SAME two rounds regardless of
         # local failures: (1) publish its ring address or a FAIL
         # marker, read everyone's; (2) publish connect ok/failed, read
-        # everyone's. Unanimity decides; because even a failing rank
-        # completes both rounds before tearing down, peers observe its
-        # markers promptly (no blocking-get timeout), and every key —
-        # markers included — is deleted at close AFTER the final round,
-        # so the namespace is clean for the next incarnation (keys are
-        # namespaced by the launcher endpoints, which fresh elastic
-        # joiners share; a CRASHED process leaves stale keys, which
-        # allow_overwrite republishing repairs).
+        # everyone's. Unanimity decides; even a failing rank completes
+        # both rounds before tearing down, so peers observe its markers
+        # promptly (no blocking-get timeout).
+        #
+        # The namespace is INCARNATION-SCOPED so one incarnation's keys
+        # can never be read by another: elastic epochs already get
+        # fresh controller endpoints per replan (distinct ns), and the
+        # elastic epoch is mixed in besides; static worlds mix in the
+        # per-process init generation, which advances in lockstep
+        # (every rank runs the same init/shutdown sequence).  This is
+        # what makes teardown SAFE: a demoted rank leaves its markers
+        # behind (deleting them raced a peer's blocking read into a
+        # full KV timeout — a measured, intermittent ~60 s init stall),
+        # and the next incarnation's reads can't be poisoned because
+        # they use different keys.
         import hashlib
+        try:
+            from ..runner.elastic.worker import current_epoch
+            epoch = current_epoch()
+        except Exception:
+            epoch = 0
+        incarnation = (f"e{epoch}" if epoch
+                       else f"g{getattr(state, 'init_generation', 0)}")
         ns = hashlib.sha1(
             (os.environ.get("HOROVOD_TPU_COORDINATOR", "") + "|" +
-             os.environ.get("HOROVOD_CONTROLLER_ADDR", "")).encode()
+             os.environ.get("HOROVOD_CONTROLLER_ADDR", "") + "|" +
+             incarnation).encode()
         ).hexdigest()[:12]
         addr_key = f"hvd_ring/{ns}/addr/{{}}"
         ok_key = f"hvd_ring/{ns}/ok/{{}}"
@@ -196,7 +211,15 @@ class RingBackend(Backend):
             if err is None and not any(a == "FAIL" for a in addrs):
                 rc = lib.hvd_ring_connect(self._comm,
                                           ",".join(addrs).encode())
-            # Round 2: unanimous connect outcome.
+            # Round 2: unanimous connect outcome.  The 60 s blocking
+            # read covers the native connect/accept bounds
+            # (collectives.cc: 30 s connect retry, 60 s accept poll);
+            # a local timeout here must RAISE, never silently count as
+            # "0" — a rank demoting alone while peers keep the ring
+            # would hang the first collective.  Markers are never
+            # deleted mid-protocol (see the namespace comment), so the
+            # only way to miss one is a dead peer, which is fatal to
+            # the job anyway.
             self._publish(ok_key.format(self.rank),
                           "1" if rc == 0 else "0")
             oks = [client.blocking_key_value_get(ok_key.format(r),
@@ -209,7 +232,14 @@ class RingBackend(Backend):
                     f"ring setup incomplete (rc={rc}, oks={oks}, "
                     f"addrs={addrs}); all ranks use the XLA fallback")
         except Exception:
-            self.close()
+            # Demotion path: LEAVE the marker keys.  A peer may be
+            # mid-blocking-read on them; deleting now races its read
+            # into a full KV timeout — measured as an intermittent
+            # ~60 s stall inside hvd.init() on 1-core rigs (the peer
+            # then demotes anyway).  Leftovers are harmless: the
+            # namespace is incarnation-scoped, so no later init can
+            # read them.
+            self.close(delete_keys=False)
             raise
         logger.debug("ring backend up: rank %d/%d via %s", self.rank,
                      self.size, my_addr)
@@ -263,7 +293,7 @@ class RingBackend(Backend):
             raise RuntimeError("ring backend is closed")
         return self._comm
 
-    def close(self):
+    def close(self, delete_keys: bool = True):
         if self._comm is not None:
             # The fusion lock is held for the duration of every ring
             # call, so acquiring it serializes destroy against any
@@ -271,10 +301,15 @@ class RingBackend(Backend):
             with self._fusion_lock:
                 self._lib.hvd_ring_destroy(self._comm)
                 self._comm = None
-        # Clear rendezvous keys so a later init() against a persistent
-        # jax.distributed client never reads this incarnation's
-        # (now-dead) addresses.
+        # Hygiene only (the namespace is incarnation-scoped, so stale
+        # keys can never be read by a later init): clean up on an
+        # established-ring close, where every rank necessarily finished
+        # both rendezvous rounds long ago.  Skipped on the
+        # init-demotion path: peers may still be blocking-reading the
+        # markers (see the demotion comment in __init__).
         keys, self._keys = self._keys, []
+        if not delete_keys:
+            return
         for key in keys:
             try:
                 self._client.key_value_delete(key)
@@ -500,13 +535,15 @@ class RingBackend(Backend):
     def _my_index(self, ps_ranks) -> int:
         return ps_ranks.index(self.rank) if ps_ranks else self.rank
 
-    def alltoall(self, array, splits, ps_ranks=()):
+    def alltoall(self, array, splits, ps_ranks=(), split_matrix=None):
         """Pairwise-exchange alltoall over the native mesh, matching the
         XLA backend's semantics (splits = dim-0 row counts per
         destination; returns (output, recv_splits) — reference
         operations.cc:1099-1160, AlltoallGetRecvSplits
         mpi_controller.cc:212-223). Pure data movement, so any dtype
-        goes over the wire as raw bytes."""
+        goes over the wire as raw bytes.  ``split_matrix`` (flattened
+        group×group, coordinator-assembled) skips the native split
+        allgather when provided."""
         ps_ranks = tuple(ps_ranks)
         ranks_arr, nranks, gsize = self._group_args(ps_ranks)
         my_idx = self._my_index(ps_ranks)
@@ -528,17 +565,25 @@ class RingBackend(Backend):
             raise ValueError(
                 f"splits must be non-negative and sum to the first "
                 f"dimension ({a.shape[0]}), got {splits.tolist()}")
-        # Split-matrix exchange (small): recv splits are column my_idx.
-        mat = np.empty(gsize * gsize, np.int64)
-        counts8 = (ctypes.c_longlong * gsize)(*([8 * gsize] * gsize))
-        rc = self._call(
-            self._lib.hvd_ring_allgather,
-            splits.ctypes.data_as(ctypes.c_void_p),
-            splits.nbytes, mat.ctypes.data_as(ctypes.c_void_p),
-            counts8, ranks_arr, nranks)
-        if rc != 0:
-            raise RuntimeError(f"ring alltoall splits failed (rc={rc})")
-        recv_splits = mat.reshape(gsize, gsize)[:, my_idx].copy()
+        if split_matrix is not None and \
+                len(split_matrix) == gsize * gsize:
+            # Coordinator piggybacked the matrix on the response.
+            recv_splits = np.asarray(split_matrix, np.int64) \
+                .reshape(gsize, gsize)[:, my_idx].copy()
+        else:
+            # Split-matrix exchange (small): recv = column my_idx.
+            mat = np.empty(gsize * gsize, np.int64)
+            counts8 = (ctypes.c_longlong * gsize)(
+                *([8 * gsize] * gsize))
+            rc = self._call(
+                self._lib.hvd_ring_allgather,
+                splits.ctypes.data_as(ctypes.c_void_p),
+                splits.nbytes, mat.ctypes.data_as(ctypes.c_void_p),
+                counts8, ranks_arr, nranks)
+            if rc != 0:
+                raise RuntimeError(
+                    f"ring alltoall splits failed (rc={rc})")
+            recv_splits = mat.reshape(gsize, gsize)[:, my_idx].copy()
 
         row_bytes = a.dtype.itemsize * int(np.prod(a.shape[1:],
                                                    initial=1))

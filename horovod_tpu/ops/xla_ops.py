@@ -475,7 +475,7 @@ class XlaMeshBackend(Backend):
             return jnp.concatenate(pieces, axis=0)
         return unpack
 
-    def alltoall(self, array, splits, ps_ranks=()):
+    def alltoall(self, array, splits, ps_ranks=(), split_matrix=None):
         mesh, gsize, my_idx = self._group(tuple(ps_ranks))
         was_jax = isinstance(array, jax.Array)
         arr = jnp.asarray(array) if was_jax else \
@@ -484,12 +484,19 @@ class XlaMeshBackend(Backend):
             splits = np.array(even_row_counts(arr.shape[0], gsize),
                               dtype=np.int64)
         splits = np.asarray(splits, dtype=np.int64)
-        # Exchange the split matrix first (small; the recv split vector
-        # is part of the public API so it lives on the host anyway —
-        # reference AlltoallGetRecvSplits, mpi_controller.cc:212-223).
-        split_mat = np.asarray(self.allgather(
-            [splits], sizes=[gsize] * gsize,
-            ps_ranks=ps_ranks)[0]).reshape(gsize, gsize)
+        if split_matrix is not None and len(split_matrix) == gsize * gsize:
+            # Coordinator piggybacked every rank's send splits on the
+            # response (reference AlltoallGetRecvSplits,
+            # mpi_controller.cc:212-223) — no split-exchange collective.
+            split_mat = np.asarray(split_matrix,
+                                   dtype=np.int64).reshape(gsize, gsize)
+        else:
+            # Direct (runtime-less) call: exchange the split matrix on
+            # the data plane (small; the recv split vector is part of
+            # the public API so it lives on the host anyway).
+            split_mat = np.asarray(self.allgather(
+                [splits], sizes=[gsize] * gsize,
+                ps_ranks=ps_ranks)[0]).reshape(gsize, gsize)
         recv_splits = split_mat[:, my_idx].copy()
         maxchunk = int(split_mat.max()) if split_mat.size else 0
         pack = self._a2a_pack_fn(tuple(int(s) for s in splits), maxchunk,
