@@ -93,10 +93,16 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
     import jax
     from jax.experimental import io_callback
 
-    def host_reduce(*arrs):
+    def host_reduce(gate, *arrs):
         # Runs EAGERLY once per step (the compiled program suspends
         # into it), so world size and scale factors track elastic
-        # resizes even though the traced program is cached.
+        # resizes even though the traced program is cached.  A zero
+        # gate (non-update step under gradient accumulation) skips the
+        # wire entirely; every rank computes the same gate so the
+        # coordinator's submission counts stay in lockstep.
+        if not int(gate):
+            return tuple(np.ascontiguousarray(np.asarray(a))
+                         for a in arrs)
         prescale, postscale, reduce_op = _scales(
             op, gradient_predivide_factor, process_set)
         arrs = [np.asarray(a) for a in arrs]
@@ -114,7 +120,7 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
 
     warned_idle = []
 
-    def allreduce_grads(grads, variables=None):
+    def allreduce_grads(grads, variables=None, gate=None):
         grads = list(grads)
         index = [i for i, g in enumerate(grads) if g is not None]
         # The skip may be decided at TRACE time only when the world
@@ -173,7 +179,16 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
         flat = [grads[i] for i in index]
         shapes = tuple(jax.ShapeDtypeStruct(g.shape, g.dtype)
                        for g in flat)
-        reduced = io_callback(host_reduce, shapes, *flat,
+        # The gate rides as a traced operand: with gradient
+        # accumulation the callback must run EVERY step (static
+        # program, coordinator submission order), but the wire
+        # collective is skipped on non-update steps — all ranks
+        # compute the same gate (iterations advance in lockstep), so
+        # the coordinator's counts stay aligned.
+        import jax.numpy as jnp
+        gate_t = jnp.asarray(1, jnp.int32) if gate is None else \
+            jnp.asarray(gate, jnp.int32)
+        reduced = io_callback(host_reduce, shapes, gate_t, *flat,
                               ordered=True)
         if not isinstance(reduced, (list, tuple)):
             reduced = (reduced,)
@@ -181,6 +196,7 @@ def _jax_grads_fn(compression, op, gradient_predivide_factor,
             grads[i] = r
         return grads
 
+    allreduce_grads.supports_gate = True
     return allreduce_grads
 
 
@@ -262,55 +278,72 @@ def create_distributed_optimizer(optimizer, name=None,
         # override would silently skip gradient sync under
         # KERAS_BACKEND=jax model.fit).
         def apply(self, grads, trainable_variables=None):
-            try:
-                import tensorflow as tf
-                eager = tf.executing_eagerly()
-            except ImportError:
-                eager = True
-            grads = list(grads)
             if self._hvd_backward_passes > 1:
-                try:
-                    import jax as _jax
-                    traced = any(isinstance(g, _jax.core.Tracer)
-                                 for g in grads)
-                except ImportError:
-                    traced = False
-                if not eager or traced:
-                    # tf.executing_eagerly() is True during JAX
-                    # tracing (TF isn't the one tracing), so the
-                    # tracer check catches the jitted-jax train step.
-                    raise NotImplementedError(
-                        "backward_passes_per_step > 1 requires eager "
-                        "execution (compile with run_eagerly=True); the "
-                        "compiled-path equivalent lives in "
-                        "horovod_tpu.jax / horovod_tpu.training.")
-                grads = self._hvd_accumulate(grads)
-                if grads is None:
-                    return None
+                # Accumulation mode: the sync moves to
+                # _backend_update_step (below), which keras hands the
+                # AVERAGED ACCUMULATED gradients exactly on update
+                # steps — compiled or eager, any backend (reference
+                # semantics: tensorflow/gradient_aggregation.py's
+                # LocalGradientAggregationHelper, re-expressed on
+                # keras-3's native gradient_accumulation_steps).
+                return super().apply(grads, trainable_variables)
             reduced = self._hvd_allreduce_grads(
-                grads, trainable_variables)
+                list(grads), trainable_variables)
             return super().apply(reduced, trainable_variables)
 
-        def _hvd_accumulate(self, grads):
-            acc = self.__dict__.setdefault("_hvd_acc", None)
-            n = self.__dict__.setdefault("_hvd_count", 0) + 1
-            if acc is None:
-                acc = [np.array(g) for g in grads]
-            else:
-                acc = [a + np.array(g) for a, g in zip(acc, grads)]
-            if n < self._hvd_backward_passes:
-                self.__dict__["_hvd_acc"] = acc
-                self.__dict__["_hvd_count"] = n
-                return None
-            self.__dict__["_hvd_acc"] = None
-            self.__dict__["_hvd_count"] = 0
-            scale = (self._hvd_backward_passes
-                     if self._hvd_average_aggregated else 1)
-            return [a / scale for a in acc]
+        def _clip_gradients(self, grads):
+            if self._hvd_backward_passes > 1:
+                # Deferred to _backend_update_step so clipnorm/
+                # clipvalue apply to the SYNCED gradient (clip of the
+                # average, at the user's threshold) — same ordering as
+                # the backward_passes=1 path, where apply() reduces
+                # before super().apply clips.
+                return grads
+            return super()._clip_gradients(grads)
+
+        def _backend_update_step(self, grads, trainable_variables,
+                                 learning_rate):
+            if self._hvd_backward_passes > 1:
+                from keras import ops as K
+                n = self._hvd_backward_passes
+                # Mirrors keras's is_update_step: on the jax backend
+                # this method runs EVERY step (with discarded results
+                # off-step); the gate lets the reducer skip the wire
+                # on non-update steps while keeping the per-step
+                # callback order identical on all ranks.
+                gate = K.equal(K.mod(self._iterations + 1, n), 0)
+                if not self._hvd_average_aggregated:
+                    # keras accumulates the MEAN over the N passes;
+                    # the reference default is their SUM (then the
+                    # reducer averages across ranks).
+                    grads = [g * float(n) if g is not None else None
+                             for g in grads]
+                fn = self._hvd_allreduce_grads
+                if getattr(fn, "supports_gate", False):
+                    grads = fn(grads, trainable_variables, gate=gate)
+                else:
+                    # Reducing off-step values is numerically safe:
+                    # keras discards every off-step update (cond /
+                    # value-select), and all ranks reduce in lockstep.
+                    grads = fn(grads, trainable_variables)
+                grads = super()._clip_gradients(list(grads))
+            super()._backend_update_step(grads, trainable_variables,
+                                         learning_rate)
 
     dist_name = name or "Distributed" + cls.__name__
     _DistributedOptimizer.__name__ = dist_name
-    new_opt = _DistributedOptimizer.from_config(optimizer.get_config())
+    config = optimizer.get_config()
+    if backward_passes_per_step > 1:
+        # Local accumulation rides keras-3's native machinery (state
+        # in optimizer slots, cond/value-select per backend) so it
+        # works inside compiled train steps.
+        if config.get("gradient_accumulation_steps"):
+            raise ValueError(
+                "Pass either backward_passes_per_step (horovod API) "
+                "or gradient_accumulation_steps (keras API), not "
+                "both.")
+        config["gradient_accumulation_steps"] = backward_passes_per_step
+    new_opt = _DistributedOptimizer.from_config(config)
     new_opt._hvd_allreduce_grads = allreduce_grads
     new_opt._hvd_backward_passes = backward_passes_per_step
     new_opt._hvd_average_aggregated = average_aggregated_gradients

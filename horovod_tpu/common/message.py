@@ -208,7 +208,7 @@ class Response:
         psr = self.process_set_ranks
         bits = self.cache_bits
         head = struct.pack(
-            "<iiddiiiHHHHHHH", int(self.response_type),
+            "<iiddiiiHIHHHHH", int(self.response_type),
             int(self.tensor_type),
             self.prescale_factor, self.postscale_factor,
             self.process_set_id, self.root_rank, self.last_joined_rank,
@@ -231,7 +231,7 @@ class Response:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Response":
-        head_fmt = "<iiddiiiHHHHHHH"
+        head_fmt = "<iiddiiiHIHHHHH"
         (rtype, dtype, pre, post, psid, root, last_joined, n_names,
          n_sizes, err_len, op_len, n_shapes, n_psr,
          n_bits) = struct.unpack_from(head_fmt, data)

@@ -193,7 +193,9 @@ std::vector<uint8_t> serialize_response(const Response& r) {
   w.put<int32_t>(r.root);
   w.put<int32_t>(r.last_joined);
   w.put<uint16_t>(uint16_t(r.names.size()));
-  w.put<uint16_t>(uint16_t(r.sizes.size()));
+  // uint32: alltoall piggybacks a group^2 split matrix here, which
+  // overflows uint16 at 256-rank groups (mirrors message.py "<...I...>").
+  w.put<uint32_t>(uint32_t(r.sizes.size()));
   w.put<uint16_t>(uint16_t(r.error.size()));
   w.put<uint16_t>(uint16_t(r.op.size()));
   w.put<uint16_t>(uint16_t(r.shapes.size()));

@@ -15,6 +15,8 @@ import itertools
 import threading
 from typing import Any, List, Optional, Sequence
 
+import numpy as np
+
 from ..common import basics
 from ..common.basics import (Adasum, Average, Max, Min, Product, Sum,
                              ProcessSet, global_process_set)
@@ -101,6 +103,14 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
         tensor_name=name, tensor=tensor,
         callback=handle._complete, root_rank=root_rank,
         process_set_id=process_set.process_set_id, splits=splits)
+    # Shapeless inputs (python lists/scalars) are normalized to numpy
+    # up front: the request must report their REAL shape/dtype (the
+    # coordinator validates alltoall splits against dim 0 and
+    # substitutes zeros by shape for joined ranks), and the backends
+    # all start from np.asarray anyway.
+    if tensor is not None and not hasattr(tensor, "dtype"):
+        tensor = np.asarray(tensor)
+    shape = tuple(tensor.shape) if tensor is not None else ()
     wire_splits = ()
     if request_type == RequestType.ALLTOALL:
         # Send splits ride the request so the coordinator can hand every
@@ -108,7 +118,7 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
         # exchange).  splits=None means an even dim-0 split.
         if splits is None:
             from .backend import even_row_counts
-            dim0 = tuple(getattr(tensor, "shape", ()) or (1,))[0]
+            dim0 = shape[0] if shape else 1
             wire_splits = tuple(
                 even_row_counts(int(dim0), process_set.size()))
         else:
@@ -117,7 +127,7 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
         request_rank=basics.rank(),
         request_type=request_type,
         tensor_name=name,
-        tensor_shape=tuple(getattr(tensor, "shape", ()) or ()),
+        tensor_shape=shape,
         tensor_type=dtype_of(tensor) if tensor is not None else 0,
         root_rank=root_rank,
         prescale_factor=prescale,
