@@ -61,6 +61,23 @@ def peak_bf16_tflops(device) -> float:
     return 0.0
 
 
+def enable_compile_cache():
+    """Persistent XLA compilation cache under the repo.  Over the
+    tunnel a cold ResNet-50 compile is minutes; the cache makes every
+    bench/profiler run after the first start in seconds."""
+    try:
+        import jax
+        cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knobs: cold compiles only
+
+
 def compiled_flops(jitted, *args):
     """Per-call FLOPs from XLA's cost analysis; 0.0 if unavailable."""
     try:
@@ -126,7 +143,12 @@ def build_resnet_train_step(batch_size: int, image_size: int,
                     dtype=jnp.bfloat16)
     labels = jnp.asarray(rng.randint(0, num_classes, batch_size),
                          dtype=jnp.int32)
-    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    # Jit the init: unjitted flax init runs the forward op-by-op on
+    # the default device — over the axon tunnel that is hundreds of
+    # per-op round trips/compiles (the r03/r04 "wedged probe" was
+    # this, not the device claim).  One compiled program instead.
+    variables = jax.jit(lambda r, xx: model.init(r, xx, train=True))(
+        jax.random.PRNGKey(0), x)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.01, momentum=0.9)
     opt_state = tx.init(params)
@@ -228,7 +250,7 @@ def bench_bert(args, smoke: bool) -> dict:
     # 15% MLM masking, the BERT pretraining rate.
     mask = jnp.asarray(rng.rand(batch, seq) < 0.15, dtype=jnp.int32)
 
-    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), ids)["params"]
     tx = optax.adamw(1e-4, weight_decay=0.01)
     opt_state = tx.init(params)
 
@@ -701,6 +723,7 @@ def main():
     import jax
     if args.smoke or tpu_error:
         jax.config.update("jax_platforms", "cpu")
+    enable_compile_cache()
 
     try:
         dev = jax.devices()[0]

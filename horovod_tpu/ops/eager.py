@@ -99,17 +99,19 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
             process_set: ProcessSet = global_process_set) -> Handle:
     runtime = _runtime()
     handle = Handle(name)
+    # Shapeless inputs (python lists/scalars) are normalized to numpy
+    # up front: the request must report their REAL shape/dtype (the
+    # coordinator validates alltoall splits against dim 0 and
+    # substitutes zeros by shape for joined ranks), the backends all
+    # start from np.asarray anyway, and the table entry must carry the
+    # converted array so single-process worlds return the same type as
+    # multi-rank ones.
+    if tensor is not None and not hasattr(tensor, "dtype"):
+        tensor = np.asarray(tensor)
     entry = TensorTableEntry(
         tensor_name=name, tensor=tensor,
         callback=handle._complete, root_rank=root_rank,
         process_set_id=process_set.process_set_id, splits=splits)
-    # Shapeless inputs (python lists/scalars) are normalized to numpy
-    # up front: the request must report their REAL shape/dtype (the
-    # coordinator validates alltoall splits against dim 0 and
-    # substitutes zeros by shape for joined ranks), and the backends
-    # all start from np.asarray anyway.
-    if tensor is not None and not hasattr(tensor, "dtype"):
-        tensor = np.asarray(tensor)
     shape = tuple(tensor.shape) if tensor is not None else ()
     wire_splits = ()
     if request_type == RequestType.ALLTOALL:

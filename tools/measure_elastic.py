@@ -1,0 +1,301 @@
+"""Elastic re-formation latency measurement (VERDICT r4 item 9).
+
+Measures the number elasticity lives or dies by: wall-clock time from a
+worker's hard death (``os._exit(1)``, no cleanup) to the first
+completed post-resize training step on a survivor, at nproc=3, for
+
+  * the JAX re-init path (``horovod_tpu.jax.elastic`` — in-process
+    jax.distributed re-formation, committed state never leaves memory);
+  * the in-graph TF context-reset path (``HOROVOD_TF_ELASTIC_GRAPH=1``
+    — full ``context._reset_context()`` + cluster re-formation +
+    retrace, reference analog: the reference rebuilds the NCCL
+    communicator + re-runs broadcast on every resize,
+    reference/horovod/runner/elastic/driver.py recovery flow).
+
+Every worker prints wall-clock (``time.time()``) stamps; all workers
+run on one machine so the stamps share a clock.  The latency decomposes
+into driver-side detection (the dead worker's exit must surface),
+survivor unwind (HorovodInternalError → restore committed state),
+rendezvous + world re-formation, and (TF only) retrace/recompile of the
+train function.  The first post-resize step time is reported separately
+from the steady-state step time so the one-off compile cost is visible.
+
+Usage:
+    python tools/measure_elastic.py [--runs 3] [--paths jax tf]
+
+Prints one JSON object; numbers are recorded in docs/elastic.md.
+"""
+
+import argparse
+import json
+import os
+import re
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+JAX_WORKER = """
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+import horovod_tpu.jax as hj
+from horovod_tpu.jax.elastic import JaxState, run
+
+hvd.init()
+state = JaxState(epoch=0)
+STOP = os.environ["TEST_STOP_FILE"]
+DOOMED = os.environ["HOROVOD_HOSTNAME"] == os.environ["TEST_DOOMED_HOST"]
+
+@run
+def train(state):
+    while not os.path.exists(STOP):
+        if DOOMED and state.epoch >= 3:
+            print(f"DYING t={time.time():.6f}", flush=True)
+            os._exit(1)
+        t0 = time.perf_counter()
+        val = np.asarray(hj.allreduce(
+            np.ones(4, np.float32), op=hvd.Sum,
+            name=f"t{state.epoch}"))
+        assert val[0] == hvd.size(), (val, hvd.size())
+        ms = (time.perf_counter() - t0) * 1e3
+        print(f"EPOCH {state.epoch} rank={hvd.rank()} "
+              f"size={hvd.size()} ms={ms:.2f} t={time.time():.6f}",
+              flush=True)
+        state.epoch += 1
+        state.commit()
+        time.sleep(0.02)
+    return state.epoch
+
+train(state)
+print(f"DONE rank={hvd.rank()}", flush=True)
+"""
+
+TF_WORKER = """
+import os, sys, time
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+STOP = os.environ["TEST_STOP_FILE"]
+DOOMED = os.environ["HOROVOD_HOSTNAME"] == os.environ["TEST_DOOMED_HOST"]
+
+
+def build():
+    m = tf.keras.Sequential(
+        [tf.keras.layers.Dense(8, input_shape=(4,)),
+         tf.keras.layers.Dense(1)])
+    o = tf.keras.optimizers.SGD(0.01)
+
+    @tf.function
+    def step(x, y):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((m(x) - y) ** 2)
+        tape = hvd.DistributedGradientTape(tape)
+        g = tape.gradient(loss, m.trainable_variables)
+        o.apply_gradients(zip(g, m.trainable_variables))
+        return loss
+    return m, o, step
+
+
+m, o, step = build()
+x, y = tf.ones((2, 4)), tf.ones((2, 1))
+step(x, y)
+state = hvd.elastic.TensorFlowKerasState(m, o, epoch=0)
+
+
+def on_reset():
+    global m, o, step
+    m, o, step = build()
+    step(x, y)
+    state.rebuild(m, o)
+
+
+state.register_reset_callbacks([on_reset])
+
+
+@hvd.elastic.run
+def train(state):
+    while not os.path.exists(STOP):
+        if DOOMED and state.epoch >= 3:
+            print(f"DYING t={time.time():.6f}", flush=True)
+            os._exit(1)
+        t0 = time.perf_counter()
+        step(x, y)
+        ms = (time.perf_counter() - t0) * 1e3
+        print(f"EPOCH {state.epoch} rank={hvd.rank()} "
+              f"size={hvd.size()} ms={ms:.2f} t={time.time():.6f}",
+              flush=True)
+        state.epoch += 1
+        state.commit()
+        time.sleep(0.02)
+    return state.epoch
+
+
+train(state)
+print(f"DONE rank={hvd.rank()}", flush=True)
+"""
+
+
+def _scan_logs(outdir):
+    text = ""
+    if not os.path.isdir(outdir):
+        return text
+    for root, _, files in os.walk(outdir):
+        for f in files:
+            with open(os.path.join(root, f), errors="replace") as fh:
+                text += fh.read()
+    return text
+
+
+def run_scenario(worker_src, extra_env, tmp, label):
+    """3 workers (localhost:2 + 127.0.0.1:1), the 127.0.0.1 one
+    hard-dies at epoch 3; returns the latency decomposition dict."""
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner.elastic_run import launch_elastic
+
+    hosts_file = os.path.join(tmp, "hosts.txt")
+    with open(hosts_file, "w") as f:
+        f.write("localhost:2\n127.0.0.1:1\n")
+    script = os.path.join(tmp, "discover.sh")
+    with open(script, "w") as f:
+        f.write("#!/bin/sh\ncat %s\n" % hosts_file)
+    os.chmod(script, 0o755)
+    stop_file = os.path.join(tmp, "stop")
+    worker_py = os.path.join(tmp, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(worker_src)
+    outdir = os.path.join(tmp, "out")
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    result = {}
+
+    def run_launcher():
+        try:
+            result["codes"] = launch_elastic(
+                [sys.executable, worker_py],
+                discovery=HostDiscoveryScript(script, 1),
+                np=3, min_np=2, max_np=3,
+                elastic_timeout=90,
+                output_filename=outdir,
+                env=env,
+                extra_worker_env=dict({
+                    "HOROVOD_TPU_FORCE_CPU": "1",
+                    "TEST_STOP_FILE": stop_file,
+                    "TEST_DOOMED_HOST": "127.0.0.1",
+                    "HOROVOD_START_TIMEOUT": "120",
+                }, **extra_env))
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=run_launcher, daemon=True)
+    t.start()
+
+    def wait_for(pattern, timeout=300):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if re.search(pattern, _scan_logs(outdir)):
+                return
+            if not t.is_alive():
+                raise RuntimeError(
+                    "launcher exited early (%s): %s\nlogs:\n%s"
+                    % (label, result, _scan_logs(outdir)[-3000:]))
+            time.sleep(0.2)
+        raise RuntimeError("pattern %r never appeared (%s); logs:\n%s"
+                           % (pattern, label,
+                              _scan_logs(outdir)[-3000:]))
+
+    wait_for(r"EPOCH \d+ rank=\d size=3")
+    wait_for(r"DYING")
+    wait_for(r"EPOCH \d+ rank=\d size=2")
+    # Let survivors take a few steady-state post-resize steps.
+    deadline = time.monotonic() + 60
+    while (len(re.findall(r"size=2", _scan_logs(outdir))) < 8
+           and time.monotonic() < deadline):
+        time.sleep(0.2)
+    with open(stop_file, "w"):
+        pass
+    t.join(timeout=180)
+    logs = _scan_logs(outdir)
+
+    death_t = max(float(m) for m in
+                  re.findall(r"DYING t=([\d.]+)", logs))
+    post = []   # (wallclock_at_step_end, step_ms)
+    for ms, ts in re.findall(
+            r"EPOCH \d+ rank=\d size=2 ms=([\d.]+) t=([\d.]+)", logs):
+        post.append((float(ts), float(ms)))
+    post.sort()
+    if not post:
+        raise RuntimeError("no post-resize steps in logs (%s)" % label)
+    first_t, first_ms = post[0]
+    steady = [ms for _, ms in post[2:]] or [first_ms]
+    return {
+        "death_to_first_post_resize_step_s":
+            round(first_t - death_t, 2),
+        "first_post_resize_step_ms": round(first_ms, 1),
+        "steady_state_step_ms": round(statistics.median(steady), 2),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--paths", nargs="+", default=["jax", "tf"],
+                   choices=["jax", "tf"])
+    args = p.parse_args()
+
+    scenarios = {
+        "jax_reinit": (JAX_WORKER, {}),
+        "tf_context_reset": (TF_WORKER, {
+            "HOROVOD_TF_ELASTIC_GRAPH": "1",
+            "TF_CPP_MIN_LOG_LEVEL": "2",
+        }),
+    }
+    wanted = {"jax": "jax_reinit", "tf": "tf_context_reset"}
+    out = {"nproc": 3, "runs": args.runs}
+    for path in args.paths:
+        name = wanted[path]
+        src, extra = scenarios[name]
+        samples = []
+        for i in range(args.runs):
+            with tempfile.TemporaryDirectory() as tmp:
+                try:
+                    samples.append(run_scenario(src, extra, tmp,
+                                                "%s#%d" % (name, i)))
+                except RuntimeError as e:
+                    samples.append({"error": str(e)[:500]})
+            print("# %s run %d: %s" % (name, i, samples[-1]),
+                  file=sys.stderr, flush=True)
+        good = [s for s in samples if "error" not in s]
+        agg = {"samples": samples}
+        if good:
+            lat = [s["death_to_first_post_resize_step_s"]
+                   for s in good]
+            agg.update({
+                "death_to_first_post_resize_step_s_median":
+                    round(statistics.median(lat), 2),
+                "death_to_first_post_resize_step_s_best":
+                    round(min(lat), 2),
+                "first_post_resize_step_ms_median": round(
+                    statistics.median(
+                        s["first_post_resize_step_ms"]
+                        for s in good), 1),
+                "steady_state_step_ms_median": round(
+                    statistics.median(s["steady_state_step_ms"]
+                                      for s in good), 2),
+            })
+        out[name] = agg
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Torch host-staging cost measurement (VERDICT r4 item 10).
+
+Quantifies what the torch binding's host-staged data path costs
+relative to the same collective fed numpy directly, so the device-plane
+position paper (docs/torch_device_plane.md) rests on numbers, not
+vibes.  Three measurements, 2 real worker processes through the full
+eager plane (TCP controller + data backend):
+
+  1. ``hvd.torch.allreduce(torch.Tensor)`` GB/s at 1/16/64 MB;
+  2. ``hvd.allreduce(numpy)`` GB/s at the same sizes (the floor the
+     torch path could reach with a zero-cost conversion);
+  3. conversion-only microbench: ``tensor.detach().cpu().numpy()`` +
+     ``torch.from_numpy(...)`` round trip per size (what the wrapper
+     itself adds, independent of the collective).
+
+Prints one JSON object.  Reference analog: the reference's native
+torch binding hands NCCL the device buffer directly
+(reference/horovod/torch/mpi_ops_v2.cc:64-192); its CPU fallback
+stages exactly like ours (*CudaOnCPU variants, mpi_ops_v2.cc:93-127).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = r"""
+import json, os, time
+import numpy as np
+import torch
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+
+hvd.init()
+RANK = hvd.rank()
+sizes_mb = json.loads(os.environ["BENCH_SIZES_MB"])
+results = []
+for mb in sizes_mb:
+    n = int(mb * 1024 * 1024 // 4)
+    iters = max(5, int(64 / mb))
+    for kind in ("torch", "numpy"):
+        if kind == "torch":
+            buf = torch.full((n,), float(RANK + 1),
+                             dtype=torch.float32)
+            reduce = lambda b=buf, mb=mb: hvd_torch.allreduce(
+                b, op=hvd.Sum, name="stage.%s.t" % mb)
+        else:
+            buf = np.full((n,), float(RANK + 1), np.float32)
+            reduce = lambda b=buf, mb=mb: np.asarray(hvd.allreduce(
+                b, op=hvd.Sum, name="stage.%s.n" % mb))
+        for _ in range(3):
+            reduce()
+        chunks = []
+        per = max(iters // 5, 1)
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(per):
+                out = reduce()
+            chunks.append(mb / 1024 * per /
+                          (time.perf_counter() - t0))
+        chunks.sort()
+        results.append({"size_mb": mb, "input": kind,
+                        "gbps": round(chunks[2], 3),
+                        "gbps_best": round(chunks[-1], 3)})
+
+# Conversion-only round trip (no collective): what the wrapper adds.
+conv = []
+for mb in sizes_mb:
+    n = int(mb * 1024 * 1024 // 4)
+    t = torch.full((n,), 1.0, dtype=torch.float32)
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        arr = t.detach().cpu().numpy()
+        back = torch.from_numpy(np.ascontiguousarray(arr))
+    dt = (time.perf_counter() - t0) / reps
+    conv.append({"size_mb": mb, "round_trip_us": round(dt * 1e6, 1)})
+
+if RANK == 0:
+    print("STAGEJSON " + json.dumps(
+        {"allreduce": results, "conversion_only": conv}))
+hvd.shutdown()
+"""
+
+
+def _free_ports(n):
+    import socket
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def main():
+    sizes = [1, 16, 64]
+    nproc = 2
+    coord_port, ctrl_port = _free_ports(2)
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(nproc),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(nproc),
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_TPU_COORDINATOR": "127.0.0.1:%d" % coord_port,
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1:%d" % ctrl_port,
+            "HOROVOD_TPU_FORCE_CPU": "1",
+            "BENCH_SIZES_MB": json.dumps(sizes),
+            "PYTHONPATH": REPO,
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+    for rc, out in zip((p.returncode for p in procs), outs):
+        if rc != 0:
+            print(json.dumps({"error": "worker rc=%s: %s"
+                              % (rc, out[-800:])}))
+            return
+    for line in outs[0].splitlines():
+        if line.startswith("STAGEJSON "):
+            data = json.loads(line[len("STAGEJSON "):])
+            # Pair torch/numpy lanes into overhead percentages.
+            by = {}
+            for r in data["allreduce"]:
+                by.setdefault(r["size_mb"], {})[r["input"]] = r
+            for mb, d in sorted(by.items()):
+                if "torch" in d and "numpy" in d:
+                    t, n = d["torch"]["gbps"], d["numpy"]["gbps"]
+                    d["torch_overhead_pct"] = round(
+                        (n - t) / t * 100, 1) if t else None
+            data["paired"] = {str(mb): {
+                "torch_gbps": d["torch"]["gbps"],
+                "numpy_gbps": d["numpy"]["gbps"],
+                "torch_overhead_pct": d.get("torch_overhead_pct")}
+                for mb, d in sorted(by.items())}
+            print(json.dumps(data, indent=1))
+            return
+    print(json.dumps({"error": "no STAGEJSON line: %s"
+                      % outs[0][-800:]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,8 +69,9 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
-    from bench import (build_resnet_train_step, peak_bf16_tflops,
-                       resnet50_analytic_flops)
+    from bench import (build_resnet_train_step, enable_compile_cache,
+                       peak_bf16_tflops, resnet50_analytic_flops)
+    enable_compile_cache()
 
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})")
