@@ -45,11 +45,16 @@ def ensure_built() -> bool:
             os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs):
         return True
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Unique tmp per process: concurrent builders (multi-proc tests
+    # racing a stale mtime) must never interleave writes into one tmp
+    # file — each builds privately, the atomic replace makes the last
+    # one win with a complete .so either way.
+    tmp = "%s.tmp.%d" % (_LIB, os.getpid())
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           *srcs, "-o", _LIB + ".tmp"]
+           *srcs, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_LIB + ".tmp", _LIB)
+        os.replace(tmp, _LIB)
         logger.info("built native coordinator: %s", _LIB)
         return True
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
@@ -57,6 +62,10 @@ def ensure_built() -> bool:
         err = getattr(e, "stderr", b"")
         logger.warning("native coordinator build failed (%s); using the "
                        "Python coordinator", (err or b"")[:500])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

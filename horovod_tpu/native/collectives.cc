@@ -34,6 +34,10 @@
 //                                int dtype, int op, void* outbuf,
 //                                const int* ranks, int nranks);
 //   int   hvd_ring_barrier(void*, const int* ranks, int nranks);
+//   int   hvd_ring_shm_setup(void*, const char* name_prefix,
+//                            long long chan_cap, const int* hostids);
+//   void  hvd_ring_shm_enable(void*);
+//   int   hvd_ring_shm_active(void*);
 //   void  hvd_ring_destroy(void*);
 //
 // dtype codes: 0=f32 1=f64 2=i32 3=i64; op codes: 0=sum 1=prod 2=min
@@ -42,14 +46,20 @@
 // internal locking is needed beyond construction.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sched.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
+#include <cstddef>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
@@ -69,12 +79,263 @@ void tune_socket(int fd) {
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
 }
 
+// ---------------------------------------------------------------------------
+// Shared-memory transport for same-host pairs.
+//
+// The analog of the reference's on-host fast paths (gloo's
+// allreduce_local / MPI's vader shared-memory BTL): a lock-free SPSC
+// byte ring per ordered same-host pair, living in one POSIX shm
+// segment per host.  Every ring algorithm below is transport-agnostic
+// via Link — same-host hops ride these channels (two memcpys, zero
+// syscalls), cross-host hops keep the TCP sockets.  On a 1-core rig
+// the win is not just copy count: loopback TCP burns the single core
+// on send/recv/poll syscalls that shm avoids entirely.
+struct ShmChan {
+  std::atomic<uint64_t> head;  // bytes produced (writer-owned)
+  char pad1[56];               // keep head/tail on separate cache lines
+  std::atomic<uint64_t> tail;  // bytes consumed (reader-owned)
+  char pad2[56];
+  char data[1];                // really `cap` bytes (runtime stride)
+};
+
+constexpr size_t kShmHdr = offsetof(ShmChan, data);
+
+// Spin briefly, then yield; a same-host peer on a shared core needs
+// the CPU to make the progress we are waiting for.  Timeout mirrors
+// the TCP paths' 30-60 s bounds.
+struct Backoff {
+  int spins = 0;
+  bool timing = false;
+  timespec start{};
+  bool step() {
+    if (++spins < 256) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+      return true;
+    }
+    if (!timing) {
+      ::clock_gettime(CLOCK_MONOTONIC, &start);
+      timing = true;
+    } else {
+      timespec now{};
+      ::clock_gettime(CLOCK_MONOTONIC, &now);
+      if (now.tv_sec - start.tv_sec > 60) return false;
+    }
+    ::sched_yield();
+    return true;
+  }
+  void reset() { spins = 0; timing = false; }
+};
+
+// Push up to n bytes into the channel; advances p/n by what fit.
+// Returns true when any progress was made.
+bool shm_push(ShmChan* ch, size_t cap, const char*& p, size_t& n) {
+  uint64_t head = ch->head.load(std::memory_order_relaxed);
+  uint64_t tail = ch->tail.load(std::memory_order_acquire);
+  size_t free_bytes = cap - static_cast<size_t>(head - tail);
+  if (free_bytes == 0 || n == 0) return false;
+  size_t k = std::min(free_bytes, n);
+  size_t off = static_cast<size_t>(head % cap);
+  size_t first = std::min(k, cap - off);
+  std::memcpy(ch->data + off, p, first);
+  std::memcpy(ch->data, p + first, k - first);
+  ch->head.store(head + k, std::memory_order_release);
+  p += k;
+  n -= k;
+  return true;
+}
+
+void reduce_buf(void* dst, const void* src, int64_t n, int dtype,
+                int op);
+size_t dtype_size(int dtype);
+
+// Pop-and-reduce: accumulate channel bytes straight into dst, skipping
+// the tmp-buffer bounce (one full write+read pass per reduce-scatter
+// step).  Consumes whole elements only.  The ring tail carries NO
+// alignment guarantee relative to the element size — byte-granular
+// ops (alltoall/allgather/broadcast) share these channels — so an
+// element straddling the wrap is reassembled through a stack bounce.
+bool shm_pop_reduce(ShmChan* ch, size_t cap, char*& p, size_t& n,
+                    int dtype, int op) {
+  size_t es = dtype_size(dtype);
+  uint64_t tail = ch->tail.load(std::memory_order_relaxed);
+  uint64_t head = ch->head.load(std::memory_order_acquire);
+  size_t avail = static_cast<size_t>(head - tail);
+  size_t k = std::min(avail, n);
+  k -= k % es;
+  if (k == 0) return false;
+  size_t off = static_cast<size_t>(tail % cap);
+  size_t contig = cap - off;  // bytes before the wrap point
+  if (contig >= k) {
+    reduce_buf(p, ch->data + off, static_cast<int64_t>(k / es),
+               dtype, op);
+  } else {
+    size_t a = contig - (contig % es);  // whole elements pre-wrap
+    reduce_buf(p, ch->data + off, static_cast<int64_t>(a / es),
+               dtype, op);
+    size_t rem = contig - a;  // leading bytes of a straddling element
+    size_t done = a;
+    if (rem > 0) {
+      char el[8];
+      std::memcpy(el, ch->data + off + a, rem);
+      std::memcpy(el + rem, ch->data, es - rem);
+      reduce_buf(p + a, el, 1, dtype, op);
+      done += es;
+    }
+    size_t start2 = (rem > 0) ? es - rem : 0;
+    reduce_buf(p + done, ch->data + start2,
+               static_cast<int64_t>((k - done) / es), dtype, op);
+  }
+  ch->tail.store(tail + k, std::memory_order_release);
+  p += k;
+  n -= k;
+  return true;
+}
+
+bool shm_pop(ShmChan* ch, size_t cap, char*& p, size_t& n) {
+  uint64_t tail = ch->tail.load(std::memory_order_relaxed);
+  uint64_t head = ch->head.load(std::memory_order_acquire);
+  size_t avail = static_cast<size_t>(head - tail);
+  if (avail == 0 || n == 0) return false;
+  size_t k = std::min(avail, n);
+  size_t off = static_cast<size_t>(tail % cap);
+  size_t first = std::min(k, cap - off);
+  std::memcpy(p, ch->data + off, first);
+  std::memcpy(p + first, ch->data, k - first);
+  ch->tail.store(tail + k, std::memory_order_release);
+  p += k;
+  n -= k;
+  return true;
+}
+
 struct RingComm {
   int rank = -1;
   int size = 0;
   int listen_fd = -1;
   std::vector<int> fds;  // peer rank -> connected fd (-1 for self)
+
+  // Shared-memory fast path (hvd_ring_shm_setup/_enable).
+  bool shm_on = false;
+  void* shm_base = nullptr;
+  size_t shm_len = 0;
+  size_t shm_cap = 0;
+  std::string shm_name;
+  int nlocal = 0;
+  int my_hostid = -1;
+  std::vector<int> hostid;     // rank -> host id
+  std::vector<int> local_idx;  // rank -> index among its host's ranks
 };
+
+// One hop to a peer: shm channels when same-host and enabled, else the
+// TCP socket.  tx is my->peer, rx is peer->my.
+struct Link {
+  int fd = -1;
+  ShmChan* tx = nullptr;
+  ShmChan* rx = nullptr;
+  size_t cap = 0;
+};
+
+Link get_link(const RingComm* c, int peer) {
+  Link l;
+  l.fd = c->fds[peer];
+  if (c->shm_on && peer != c->rank &&
+      c->hostid[peer] == c->my_hostid) {
+    size_t stride = kShmHdr + c->shm_cap;
+    char* base = static_cast<char*>(c->shm_base);
+    int L = c->nlocal;
+    int me = c->local_idx[c->rank];
+    int pj = c->local_idx[peer];
+    l.tx = reinterpret_cast<ShmChan*>(base + stride * (me * L + pj));
+    l.rx = reinterpret_cast<ShmChan*>(base + stride * (pj * L + me));
+    l.cap = c->shm_cap;
+  }
+  return l;
+}
+
+bool send_all(int fd, const void* buf, size_t n);
+bool recv_all(int fd, void* buf, size_t n);
+bool send_recv(int send_fd, const void* sbuf, size_t sn,
+               int recv_fd, void* rbuf, size_t rn);
+
+bool link_send(const Link& l, const void* buf, size_t n) {
+  if (l.tx == nullptr) return send_all(l.fd, buf, n);
+  const char* p = static_cast<const char*>(buf);
+  Backoff b;
+  while (n > 0) {
+    if (shm_push(l.tx, l.cap, p, n)) b.reset();
+    else if (!b.step()) return false;
+  }
+  return true;
+}
+
+bool link_recv(const Link& l, void* buf, size_t n) {
+  if (l.rx == nullptr) return recv_all(l.fd, buf, n);
+  char* p = static_cast<char*>(buf);
+  Backoff b;
+  while (n > 0) {
+    if (shm_pop(l.rx, l.cap, p, n)) b.reset();
+    else if (!b.step()) return false;
+  }
+  return true;
+}
+
+// Duplex exchange over two links.  shm+shm interleaves push/pop in one
+// loop (buffered channels cannot deadlock, but draining the peer while
+// our tx is full is what makes progress); tcp+tcp keeps the tuned
+// socket state machine; mixed pairs split into a sender thread + inline
+// recv (only ever a cross-host + same-host combination, where the
+// network hop dominates the thread spawn).
+bool link_send_recv(const Link& sl, const void* sbuf, size_t sn,
+                    const Link& rl, void* rbuf, size_t rn) {
+  if (sl.tx != nullptr && rl.rx != nullptr) {
+    const char* sp = static_cast<const char*>(sbuf);
+    char* rp = static_cast<char*>(rbuf);
+    Backoff b;
+    while (sn > 0 || rn > 0) {
+      bool moved = false;
+      if (sn > 0 && shm_push(sl.tx, sl.cap, sp, sn)) moved = true;
+      if (rn > 0 && shm_pop(rl.rx, rl.cap, rp, rn)) moved = true;
+      if (moved) b.reset();
+      else if (!b.step()) return false;
+    }
+    return true;
+  }
+  if (sl.tx == nullptr && rl.rx == nullptr)
+    return send_recv(sl.fd, sbuf, sn, rl.fd, rbuf, rn);
+  bool send_ok = true;
+  std::thread sender([&] { send_ok = link_send(sl, sbuf, sn); });
+  bool recv_ok = link_recv(rl, rbuf, rn);
+  sender.join();
+  return send_ok && recv_ok;
+}
+
+// Duplex exchange whose receive side ACCUMULATES into dst (the ring
+// reduce-scatter step).  Shm receive reduces straight out of the
+// channel; other transports land in tmp and reduce after (tmp is the
+// caller's per-chunk scratch, already sized to the largest chunk).
+bool link_send_recv_reduce(const Link& sl, const void* sbuf, size_t sn,
+                           const Link& rl, void* dst, size_t rn,
+                           int dtype, int op, char* tmp) {
+  if (sl.tx != nullptr && rl.rx != nullptr) {
+    const char* sp = static_cast<const char*>(sbuf);
+    char* rp = static_cast<char*>(dst);
+    Backoff b;
+    while (sn > 0 || rn > 0) {
+      bool moved = false;
+      if (sn > 0 && shm_push(sl.tx, sl.cap, sp, sn)) moved = true;
+      if (rn > 0 && shm_pop_reduce(rl.rx, rl.cap, rp, rn, dtype, op))
+        moved = true;
+      if (moved) b.reset();
+      else if (!b.step()) return false;
+    }
+    return true;
+  }
+  if (!link_send_recv(sl, sbuf, sn, rl, tmp, rn)) return false;
+  reduce_buf(dst, tmp,
+             static_cast<int64_t>(rn / dtype_size(dtype)), dtype, op);
+  return true;
+}
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -305,6 +566,70 @@ int hvd_ring_connect(void* h, const char* addrs_csv) {
   return 0;
 }
 
+// Map the per-host shared-memory segment: L*L SPSC channels of
+// `cap` bytes, L = ranks on my host.  hostids[r] labels rank r's
+// host (any consistent labeling; the Python side derives it from the
+// ring address exchange).  Does NOT flip the transport on — the
+// enable decision must be unanimous across ranks (one rank writing
+// shm while its neighbor reads TCP would hang), so the caller
+// confirms setup success on every rank first, then calls
+// hvd_ring_shm_enable everywhere.  name_prefix must be unique per
+// incarnation (stale head/tail state from a crashed job under a
+// reused name would corrupt the first op).
+int hvd_ring_shm_setup(void* h, const char* name_prefix,
+                       long long cap, const int* hostids) {
+  auto* c = static_cast<RingComm*>(h);
+  if (cap < 64 || hostids == nullptr) return -1;
+  cap &= ~7LL;  // common-case alignment (straddles still handled)
+  c->hostid.assign(hostids, hostids + c->size);
+  c->my_hostid = c->hostid[c->rank];
+  c->local_idx.assign(c->size, -1);
+  for (int r = 0; r < c->size; ++r) {
+    int n = 0;
+    for (int q = 0; q < r; ++q)
+      if (c->hostid[q] == c->hostid[r]) ++n;
+    c->local_idx[r] = n;
+  }
+  int nlocal = 0;
+  for (int r = 0; r < c->size; ++r)
+    if (c->hostid[r] == c->my_hostid) ++nlocal;
+  c->nlocal = nlocal;
+  if (nlocal <= 1) return 1;  // no same-host pair: nothing to map
+  size_t stride = kShmHdr + static_cast<size_t>(cap);
+  size_t len = stride * static_cast<size_t>(nlocal) *
+               static_cast<size_t>(nlocal);
+  std::string name = std::string("/") + name_prefix + "_h" +
+                     std::to_string(c->my_hostid);
+  int fd = ::shm_open(name.c_str(), O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return -2;
+  if (::ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    ::close(fd);
+    return -3;
+  }
+  void* base = ::mmap(nullptr, len, PROT_READ | PROT_WRITE,
+                      MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) return -4;
+  // Fresh segments are zero pages — head == tail == 0 is exactly the
+  // empty-channel state, so no explicit init (and no init race).
+  c->shm_base = base;
+  c->shm_len = len;
+  c->shm_cap = static_cast<size_t>(cap);
+  c->shm_name = name;
+  return 0;
+}
+
+void hvd_ring_shm_enable(void* h) {
+  auto* c = static_cast<RingComm*>(h);
+  if (c->shm_base != nullptr) c->shm_on = true;
+}
+
+// 1 when same-host hops ride shared memory (observability/tests).
+int hvd_ring_shm_active(void* h) {
+  auto* c = static_cast<RingComm*>(h);
+  return c->shm_on ? 1 : 0;
+}
+
 // In-place ring allreduce: reduce-scatter then allgather
 // (reference: gloo's ring algorithm, ops/gloo_operations.cc:32-75).
 int hvd_ring_allreduce(void* h, void* buf, long long n, int dtype,
@@ -318,9 +643,9 @@ int hvd_ring_allreduce(void* h, void* buf, long long n, int dtype,
   size_t es = dtype_size(dtype);
   if (es == 0) return -2;
 
-  int right = c->fds[group[(me + 1) % p]];
-  int left = c->fds[group[(me - 1 + p) % p]];
-  if (right < 0 || left < 0) return -3;
+  Link right = get_link(c, group[(me + 1) % p]);
+  Link left = get_link(c, group[(me - 1 + p) % p]);
+  if (right.fd < 0 || left.fd < 0) return -3;
 
   // Chunk boundaries: chunk i owns [off[i], off[i+1]).
   std::vector<int64_t> off(p + 1);
@@ -338,11 +663,12 @@ int hvd_ring_allreduce(void* h, void* buf, long long n, int dtype,
     int recv_c = ((me - s - 1) % p + p) % p;
     int64_t sn = off[send_c + 1] - off[send_c];
     int64_t rn = off[recv_c + 1] - off[recv_c];
-    if (!send_recv(right, base + off[send_c] * es,
-                   static_cast<size_t>(sn) * es, left, tmp.data(),
-                   static_cast<size_t>(rn) * es))
+    if (!link_send_recv_reduce(right, base + off[send_c] * es,
+                               static_cast<size_t>(sn) * es, left,
+                               base + off[recv_c] * es,
+                               static_cast<size_t>(rn) * es,
+                               dtype, op, tmp.data()))
       return -4;
-    reduce_buf(base + off[recv_c] * es, tmp.data(), rn, dtype, op);
   }
   // Allgather: circulate the finished chunks.
   for (int s = 0; s < p - 1; ++s) {
@@ -350,10 +676,10 @@ int hvd_ring_allreduce(void* h, void* buf, long long n, int dtype,
     int recv_c = ((me - s) % p + p) % p;
     int64_t sn = off[send_c + 1] - off[send_c];
     int64_t rn = off[recv_c + 1] - off[recv_c];
-    if (!send_recv(right, base + off[send_c] * es,
-                   static_cast<size_t>(sn) * es, left,
-                   base + off[recv_c] * es,
-                   static_cast<size_t>(rn) * es))
+    if (!link_send_recv(right, base + off[send_c] * es,
+                        static_cast<size_t>(sn) * es, left,
+                        base + off[recv_c] * es,
+                        static_cast<size_t>(rn) * es))
       return -4;
   }
   return 0;
@@ -374,16 +700,16 @@ int hvd_ring_allgather(void* h, const void* inbuf, long long inbytes,
   char* out = static_cast<char*>(outbuf);
   std::memcpy(out + off[me], inbuf, static_cast<size_t>(inbytes));
   if (p == 1) return 0;
-  int right = c->fds[group[(me + 1) % p]];
-  int left = c->fds[group[(me - 1 + p) % p]];
-  if (right < 0 || left < 0) return -3;
+  Link right = get_link(c, group[(me + 1) % p]);
+  Link left = get_link(c, group[(me - 1 + p) % p]);
+  if (right.fd < 0 || left.fd < 0) return -3;
   for (int s = 0; s < p - 1; ++s) {
     int send_c = ((me - s) % p + p) % p;
     int recv_c = ((me - s - 1) % p + p) % p;
-    if (!send_recv(right, out + off[send_c],
-                   static_cast<size_t>(counts[send_c]), left,
-                   out + off[recv_c],
-                   static_cast<size_t>(counts[recv_c])))
+    if (!link_send_recv(right, out + off[send_c],
+                        static_cast<size_t>(counts[send_c]), left,
+                        out + off[recv_c],
+                        static_cast<size_t>(counts[recv_c])))
       return -4;
   }
   return 0;
@@ -406,11 +732,13 @@ int hvd_ring_broadcast(void* h, void* buf, long long nbytes, int root,
   for (int dist = 1; dist < p; dist <<= 1) {
     if (vme < dist && vme + dist < p) {
       int peer = group[((vme + dist) + root) % p];
-      if (!send_all(c->fds[peer], buf, static_cast<size_t>(nbytes)))
+      if (!link_send(get_link(c, peer), buf,
+                     static_cast<size_t>(nbytes)))
         return -4;
     } else if (vme >= dist && vme < (dist << 1)) {
       int peer = group[((vme - dist) + root) % p];
-      if (!recv_all(c->fds[peer], buf, static_cast<size_t>(nbytes)))
+      if (!link_recv(get_link(c, peer), buf,
+                     static_cast<size_t>(nbytes)))
         return -4;
     }
   }
@@ -450,13 +778,13 @@ int hvd_ring_alltoall(void* h, const void* inbuf, void* outbuf,
   for (int s = 1; s < p; ++s) {
     int to = (me + s) % p;
     int from = (me - s + p) % p;
-    int sfd = c->fds[group[to]];
-    int rfd = c->fds[group[from]];
-    if (sfd < 0 || rfd < 0) return -3;
-    if (!send_recv(sfd, in + soff[to],
-                   static_cast<size_t>(sendcounts[to]), rfd,
-                   out + roff[from],
-                   static_cast<size_t>(recvcounts[from])))
+    Link sl = get_link(c, group[to]);
+    Link rl = get_link(c, group[from]);
+    if (sl.fd < 0 || rl.fd < 0) return -3;
+    if (!link_send_recv(sl, in + soff[to],
+                        static_cast<size_t>(sendcounts[to]), rl,
+                        out + roff[from],
+                        static_cast<size_t>(recvcounts[from])))
       return -4;
   }
   return 0;
@@ -485,9 +813,9 @@ int hvd_ring_reducescatter(void* h, void* buf, const long long* counts,
     std::memcpy(outbuf, base, static_cast<size_t>(counts[0]) * es);
     return 0;
   }
-  int right = c->fds[group[(me + 1) % p]];
-  int left = c->fds[group[(me - 1 + p) % p]];
-  if (right < 0 || left < 0) return -3;
+  Link right = get_link(c, group[(me + 1) % p]);
+  Link left = get_link(c, group[(me - 1 + p) % p]);
+  if (right.fd < 0 || left.fd < 0) return -3;
   int64_t max_chunk = 0;
   for (int i = 0; i < p; ++i)
     max_chunk = std::max(max_chunk, static_cast<int64_t>(counts[i]));
@@ -499,11 +827,12 @@ int hvd_ring_reducescatter(void* h, void* buf, const long long* counts,
     int recv_c = ((me - s - 2) % p + p) % p;
     int64_t sn = counts[send_c];
     int64_t rn = counts[recv_c];
-    if (!send_recv(right, base + off[send_c] * es,
-                   static_cast<size_t>(sn) * es, left, tmp.data(),
-                   static_cast<size_t>(rn) * es))
+    if (!link_send_recv_reduce(right, base + off[send_c] * es,
+                               static_cast<size_t>(sn) * es, left,
+                               base + off[recv_c] * es,
+                               static_cast<size_t>(rn) * es,
+                               dtype, op, tmp.data()))
       return -4;
-    reduce_buf(base + off[recv_c] * es, tmp.data(), rn, dtype, op);
   }
   std::memcpy(outbuf, base + off[me] * es,
               static_cast<size_t>(counts[me]) * es);
@@ -522,6 +851,12 @@ void hvd_ring_destroy(void* h) {
   for (int fd : c->fds)
     if (fd >= 0) ::close(fd);
   if (c->listen_fd >= 0) ::close(c->listen_fd);
+  if (c->shm_base != nullptr) {
+    ::munmap(c->shm_base, c->shm_len);
+    // Every local rank unlinks; after the first the rest get ENOENT,
+    // which is the desired end state either way.
+    ::shm_unlink(c->shm_name.c_str());
+  }
   delete c;
 }
 

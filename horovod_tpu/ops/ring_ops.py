@@ -104,6 +104,13 @@ def _bind(lib):
     lib.hvd_ring_barrier.restype = ctypes.c_int
     lib.hvd_ring_barrier.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.hvd_ring_shm_setup.restype = ctypes.c_int
+    lib.hvd_ring_shm_setup.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.hvd_ring_shm_enable.argtypes = [ctypes.c_void_p]
+    lib.hvd_ring_shm_active.restype = ctypes.c_int
+    lib.hvd_ring_shm_active.argtypes = [ctypes.c_void_p]
     lib.hvd_ring_destroy.argtypes = [ctypes.c_void_p]
 
 
@@ -211,26 +218,68 @@ class RingBackend(Backend):
             if err is None and not any(a == "FAIL" for a in addrs):
                 rc = lib.hvd_ring_connect(self._comm,
                                           ",".join(addrs).encode())
-            # Round 2: unanimous connect outcome.  The 60 s blocking
-            # read covers the native connect/accept bounds
-            # (collectives.cc: 30 s connect retry, 60 s accept poll);
-            # a local timeout here must RAISE, never silently count as
-            # "0" — a rank demoting alone while peers keep the ring
-            # would hang the first collective.  Markers are never
-            # deleted mid-protocol (see the namespace comment), so the
-            # only way to miss one is a dead peer, which is fatal to
-            # the job anyway.
-            self._publish(ok_key.format(self.rank),
-                          "1" if rc == 0 else "0")
+            # Shared-memory fast path for same-host pairs (the analog
+            # of the reference's on-host shared-memory transports —
+            # gloo allreduce_local / MPI vader BTL).  Host identity
+            # comes from the exchanged ring IPs; setup maps the
+            # per-host segment but transport only flips on after the
+            # unanimity round below (a rank writing shm while its
+            # neighbor reads TCP would hang the first collective).
+            shm_rc, cap = None, 0  # None: disabled / failed locally
+            if rc == 0 and os.environ.get(
+                    "HOROVOD_RING_SHM", "1").strip().lower() not in (
+                    "0", "false", "off", "no"):
+                try:
+                    cap = int(os.environ.get("HOROVOD_RING_SHM_CAP",
+                                             str(1 << 20)))
+                except ValueError:
+                    cap = 0  # bad value: lose the optimization, not
+                    #          the rank's marker publish below
+                if cap > 0:
+                    ips = [a.rsplit(":", 1)[0] for a in addrs]
+                    ids = {}
+                    hostids = (ctypes.c_int * self.size)(
+                        *[ids.setdefault(ip, len(ids)) for ip in ips])
+                    shm_rc = lib.hvd_ring_shm_setup(
+                        self._comm, f"hvdring{ns}".encode(), cap,
+                        hostids)
+            # Round 2: unanimous outcome.  The 60 s blocking read
+            # covers the native connect/accept bounds (collectives.cc:
+            # 30 s connect retry, 60 s accept poll); a local timeout
+            # here must RAISE, never silently count as "0" — a rank
+            # demoting alone while peers keep the ring would hang the
+            # first collective.  Markers are never deleted mid-protocol
+            # (see the namespace comment), so the only way to miss one
+            # is a dead peer, which is fatal to the job anyway.
+            # Marker values: "1:<cap>" ring + shm ok at that channel
+            # capacity, "2" ring ok / shm disabled-or-failed, "0" ring
+            # failed.  The ring forms on all-{1,2}; shm engages only
+            # when EVERY rank published "1" with the SAME cap (env
+            # asymmetry — one rank disabled, or differing
+            # HOROVOD_RING_SHM_CAP and therefore differing channel
+            # strides into one segment — must cost the optimization,
+            # never a hang or stride corruption).
+            if rc != 0:
+                mine = "0"
+            elif shm_rc in (0, 1):
+                mine = "1:%d" % cap
+            else:
+                mine = "2"
+            self._publish(ok_key.format(self.rank), mine)
             oks = [client.blocking_key_value_get(ok_key.format(r),
                                                  60_000)
                    for r in range(self.size)]
             if err is not None:
                 raise err
-            if rc != 0 or any(o != "1" for o in oks):
+            if rc != 0 or any(o != "2" and not o.startswith("1:")
+                              for o in oks):
                 raise RuntimeError(
                     f"ring setup incomplete (rc={rc}, oks={oks}, "
                     f"addrs={addrs}); all ranks use the XLA fallback")
+            if shm_rc == 0 and all(o == "1:%d" % cap for o in oks):
+                lib.hvd_ring_shm_enable(self._comm)
+            self.stats["ring_shm"] = bool(
+                lib.hvd_ring_shm_active(self._comm))
         except Exception:
             # Demotion path: LEAVE the marker keys.  A peer may be
             # mid-blocking-read on them; deleting now races its read

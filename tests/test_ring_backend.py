@@ -269,3 +269,108 @@ np.testing.assert_allclose(y, 2.0 * SIZE)
 print("REINIT OK")
 """, nproc=2, timeout=240)
     assert_all_ok(results)
+
+
+def test_ring_shm_active_and_correct_on_localhost():
+    """All ranks share one host, so same-host hops must ride the
+    shared-memory channels (collectives.cc ShmChan — the analog of
+    the reference's on-host transports, gloo allreduce_local / MPI
+    vader BTL); the op matrix must agree with TCP's results."""
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+assert state.backend.stats.get("ring_shm") is True, \\
+    state.backend.stats
+
+for dt in (np.float32, np.int64):
+    x = (np.arange(7) + RANK + 1).astype(dt)
+    y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=f"sh.{dt.__name__}"))
+    exp = (np.arange(7)[None, :] + np.arange(1, SIZE + 1)[:, None]).sum(0)
+    np.testing.assert_allclose(y.astype(np.float64), exp)
+
+# Big payload streams through the bounded channel window (chunk size
+# n/p exceeds HOROVOD_RING_SHM_CAP, so push/pop must interleave).
+big = np.full(3 * 1024 * 1024, float(RANK + 1), np.float32)  # 12 MB
+y = np.asarray(hvd.allreduce(big, op=hvd.Sum, name="sh.big"))
+np.testing.assert_allclose(y[:4], sum(range(1, SIZE + 1)))
+np.testing.assert_allclose(y[-4:], sum(range(1, SIZE + 1)))
+
+g = np.asarray(hvd.allgather(
+    np.full((RANK + 1, 2), float(RANK), np.float32), name="sh.ag"))
+assert g.shape == (SIZE * (SIZE + 1) // 2, 2), g.shape
+
+b = np.asarray(hvd.broadcast(np.full(5, float(RANK * 3), np.float32),
+                             root_rank=1, name="sh.bc"))
+np.testing.assert_allclose(b, 3.0)
+hvd.barrier()
+print("OK")
+""", nproc=3, timeout=240)
+    assert_all_ok(results)
+
+
+def test_ring_shm_disabled_falls_back_to_tcp():
+    """HOROVOD_RING_SHM=0 keeps every hop on the TCP sockets (the
+    cross-host code path, exercised on localhost)."""
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+assert state.backend.stats.get("ring_shm") is False, \\
+    state.backend.stats
+y = np.asarray(hvd.allreduce(np.full(4, float(RANK + 1), np.float32),
+                             op=hvd.Sum, name="tcp"))
+np.testing.assert_allclose(y, sum(range(1, SIZE + 1)))
+print("OK")
+""", nproc=2, timeout=240, extra_env={"HOROVOD_RING_SHM": "0"})
+    assert_all_ok(results)
+
+
+def test_ring_shm_env_asymmetry_disables_everywhere():
+    """One rank launched with HOROVOD_RING_SHM=0 must cost every rank
+    the shm optimization — never a hang (a rank writing shm while its
+    neighbor reads TCP would wedge the first collective)."""
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+assert state.backend.stats.get("ring_shm") is False, \\
+    state.backend.stats
+y = np.asarray(hvd.allreduce(np.full(4, float(RANK + 1), np.float32),
+                             op=hvd.Sum, name="asym"))
+np.testing.assert_allclose(y, sum(range(1, SIZE + 1)))
+print("OK")
+""", nproc=2, timeout=240,
+        per_rank_env=lambda r: {"HOROVOD_RING_SHM": "0"} if r == 1
+        else {})
+    assert_all_ok(results)
+
+
+def test_ring_shm_misaligned_wrap_reduce():
+    """Regression: byte-granular ops (allgather) leave the channel
+    tail misaligned relative to later element sizes; a large f64
+    allreduce must then reassemble elements straddling the ring wrap
+    (shm_pop_reduce stack bounce) instead of smearing garbage.  A
+    4 KB channel window forces many wraps per op."""
+    results = run_workers(_RING_CHECK + """
+import numpy as np
+assert state.backend.stats.get("ring_shm") is True, state.backend.stats
+
+# Misalign: 28-byte-per-rank allgather (7 f32) shifts the tail by 4.
+g = np.asarray(hvd.allgather(np.full(7, float(RANK), np.float32),
+                             name="mis.ag"))
+assert g.shape == (7 * SIZE,), g.shape
+
+# Now a big f64 allreduce: chunks cross the 4 KB wrap dozens of
+# times with tail % 8 == 4.  Exact integer-valued doubles make any
+# smeared byte show up as a wrong value.
+x = (np.arange(8192, dtype=np.float64) + 1000.0 * RANK)
+y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="mis.f64"))
+exp = SIZE * np.arange(8192, dtype=np.float64) + \\
+    1000.0 * sum(range(SIZE))
+np.testing.assert_array_equal(y, exp)
+
+# And again with f32 after re-misaligning by 12 bytes.
+g = np.asarray(hvd.allgather(np.full(3, 1.0, np.float32),
+                             name="mis.ag2"))
+x = np.full(6000, float(RANK + 1), np.float32)
+y = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="mis.f32"))
+np.testing.assert_array_equal(y, float(sum(range(1, SIZE + 1))))
+print("OK")
+""", nproc=2, timeout=240,
+        extra_env={"HOROVOD_RING_SHM_CAP": "4096"})
+    assert_all_ok(results)
