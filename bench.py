@@ -493,9 +493,14 @@ barrier_floor = timed_floor(hvd.barrier)
 
 from horovod_tpu.common import basics
 stats = dict(basics._state().runtime.controller.stats)
+backend_stats = dict(getattr(basics._state().backend, "stats", {}))
 if RANK == 0:
     print("BENCHJSON " + json.dumps({
         "results": results, "frames": stats,
+        "backend": {"type": type(basics._state().backend).__name__,
+                    "ring_shm": backend_stats.get("ring_shm"),
+                    "ring_allreduces":
+                        backend_stats.get("ring_allreduces")},
         "control_floor": {
             "tiny_allreduce_ms": tiny_floor["median_ms"],
             "tiny_allreduce": tiny_floor,

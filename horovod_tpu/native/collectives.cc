@@ -101,12 +101,36 @@ struct ShmChan {
 constexpr size_t kShmHdr = offsetof(ShmChan, data);
 
 // Spin briefly, then yield; a same-host peer on a shared core needs
-// the CPU to make the progress we are waiting for.  Timeout mirrors
-// the TCP paths' 30-60 s bounds.
+// the CPU to make the progress we are waiting for.  Unlike TCP —
+// where a dead peer closes its socket and recv() errors immediately —
+// a dead shm peer is just silence, so after the spin phase the wait
+// ALSO watches the pair's (otherwise idle) TCP socket: peer death
+// shows up there as EOF/HUP within one poll, giving shm the same
+// prompt failure detection the elastic path relies on.  The overall
+// deadline (HOROVOD_RING_SHM_TIMEOUT seconds, default 300) is the
+// backstop for a peer that is alive but wedged.
 struct Backoff {
+  int fd1 = -1;  // peer TCP sockets (idle while shm is active)
+  int fd2 = -1;
+  long timeout_s = 300;
   int spins = 0;
+  int yields = 0;
   bool timing = false;
   timespec start{};
+  explicit Backoff(int a = -1, int b = -1, long t = 300)
+      : fd1(a), fd2(b), timeout_s(t) {}
+  static bool fd_dead(int fd) {
+    if (fd < 0) return false;
+    struct pollfd pfd = {fd, POLLIN, 0};
+    if (::poll(&pfd, 1, 0) <= 0) return false;
+    if (pfd.revents & (POLLERR | POLLHUP)) return true;
+    if (pfd.revents & POLLIN) {
+      char b;
+      ssize_t k = ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+      return k == 0;  // EOF: the peer is gone
+    }
+    return false;
+  }
   bool step() {
     if (++spins < 256) {
 #if defined(__x86_64__)
@@ -117,10 +141,11 @@ struct Backoff {
     if (!timing) {
       ::clock_gettime(CLOCK_MONOTONIC, &start);
       timing = true;
-    } else {
+    } else if ((++yields & 1023) == 0) {
+      if (fd_dead(fd1) || fd_dead(fd2)) return false;
       timespec now{};
       ::clock_gettime(CLOCK_MONOTONIC, &now);
-      if (now.tv_sec - start.tv_sec > 60) return false;
+      if (now.tv_sec - start.tv_sec > timeout_s) return false;
     }
     ::sched_yield();
     return true;
@@ -220,6 +245,7 @@ struct RingComm {
   void* shm_base = nullptr;
   size_t shm_len = 0;
   size_t shm_cap = 0;
+  long shm_timeout_s = 300;
   std::string shm_name;
   int nlocal = 0;
   int my_hostid = -1;
@@ -234,11 +260,13 @@ struct Link {
   ShmChan* tx = nullptr;
   ShmChan* rx = nullptr;
   size_t cap = 0;
+  long timeout_s = 300;
 };
 
 Link get_link(const RingComm* c, int peer) {
   Link l;
   l.fd = c->fds[peer];
+  l.timeout_s = c->shm_timeout_s;
   if (c->shm_on && peer != c->rank &&
       c->hostid[peer] == c->my_hostid) {
     size_t stride = kShmHdr + c->shm_cap;
@@ -261,7 +289,7 @@ bool send_recv(int send_fd, const void* sbuf, size_t sn,
 bool link_send(const Link& l, const void* buf, size_t n) {
   if (l.tx == nullptr) return send_all(l.fd, buf, n);
   const char* p = static_cast<const char*>(buf);
-  Backoff b;
+  Backoff b(l.fd, -1, l.timeout_s);
   while (n > 0) {
     if (shm_push(l.tx, l.cap, p, n)) b.reset();
     else if (!b.step()) return false;
@@ -272,7 +300,7 @@ bool link_send(const Link& l, const void* buf, size_t n) {
 bool link_recv(const Link& l, void* buf, size_t n) {
   if (l.rx == nullptr) return recv_all(l.fd, buf, n);
   char* p = static_cast<char*>(buf);
-  Backoff b;
+  Backoff b(l.fd, -1, l.timeout_s);
   while (n > 0) {
     if (shm_pop(l.rx, l.cap, p, n)) b.reset();
     else if (!b.step()) return false;
@@ -291,7 +319,7 @@ bool link_send_recv(const Link& sl, const void* sbuf, size_t sn,
   if (sl.tx != nullptr && rl.rx != nullptr) {
     const char* sp = static_cast<const char*>(sbuf);
     char* rp = static_cast<char*>(rbuf);
-    Backoff b;
+    Backoff b(sl.fd, rl.fd, sl.timeout_s);
     while (sn > 0 || rn > 0) {
       bool moved = false;
       if (sn > 0 && shm_push(sl.tx, sl.cap, sp, sn)) moved = true;
@@ -320,7 +348,7 @@ bool link_send_recv_reduce(const Link& sl, const void* sbuf, size_t sn,
   if (sl.tx != nullptr && rl.rx != nullptr) {
     const char* sp = static_cast<const char*>(sbuf);
     char* rp = static_cast<char*>(dst);
-    Backoff b;
+    Backoff b(sl.fd, rl.fd, sl.timeout_s);
     while (sn > 0 || rn > 0) {
       bool moved = false;
       if (sn > 0 && shm_push(sl.tx, sl.cap, sp, sn)) moved = true;
@@ -579,8 +607,15 @@ int hvd_ring_connect(void* h, const char* addrs_csv) {
 int hvd_ring_shm_setup(void* h, const char* name_prefix,
                        long long cap, const int* hostids) {
   auto* c = static_cast<RingComm*>(h);
-  if (cap < 64 || hostids == nullptr) return -1;
+  // Upper bound guards the stride*L*L arithmetic against overflow
+  // (an absurd HOROVOD_RING_SHM_CAP must fail setup, not wrap into
+  // an undersized mapping with wild channel pointers).
+  if (cap < 64 || cap > (1LL << 30) || hostids == nullptr) return -1;
   cap &= ~7LL;  // common-case alignment (straddles still handled)
+  if (const char* t = ::getenv("HOROVOD_RING_SHM_TIMEOUT")) {
+    long v = ::atol(t);
+    if (v > 0) c->shm_timeout_s = v;
+  }
   c->hostid.assign(hostids, hostids + c->size);
   c->my_hostid = c->hostid[c->rank];
   c->local_idx.assign(c->size, -1);
@@ -604,12 +639,26 @@ int hvd_ring_shm_setup(void* h, const char* name_prefix,
   if (fd < 0) return -2;
   if (::ftruncate(fd, static_cast<off_t>(len)) != 0) {
     ::close(fd);
+    ::shm_unlink(name.c_str());
+    return -3;
+  }
+  // Reserve the pages NOW: tmpfs allocates lazily, so on a small
+  // /dev/shm (docker's 64 MB default) ftruncate+mmap succeed and the
+  // first large collective dies with SIGBUS mid-op.  posix_fallocate
+  // forces allocation here, where failure downgrades cleanly to the
+  // TCP path via the agreement round.
+  if (::posix_fallocate(fd, 0, static_cast<off_t>(len)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name.c_str());
     return -3;
   }
   void* base = ::mmap(nullptr, len, PROT_READ | PROT_WRITE,
                       MAP_SHARED, fd, 0);
   ::close(fd);
-  if (base == MAP_FAILED) return -4;
+  if (base == MAP_FAILED) {
+    ::shm_unlink(name.c_str());
+    return -4;
+  }
   // Fresh segments are zero pages — head == tail == 0 is exactly the
   // empty-channel state, so no explicit init (and no init race).
   c->shm_base = base;
@@ -654,7 +703,12 @@ int hvd_ring_allreduce(void* h, void* buf, long long n, int dtype,
   int64_t max_chunk = 0;
   for (int i = 0; i < p; ++i)
     max_chunk = std::max(max_chunk, off[i + 1] - off[i]);
-  std::vector<char> tmp(static_cast<size_t>(max_chunk) * es);
+  // Scratch only exists for non-shm receive hops; the shm fused
+  // pop-reduce never touches it, and a per-op multi-MB allocation
+  // would be pure waste on the hot same-host path.
+  std::vector<char> tmp;
+  if (left.rx == nullptr || right.tx == nullptr)
+    tmp.resize(static_cast<size_t>(max_chunk) * es);
 
   // Reduce-scatter: after p-1 steps, chunk (me+1)%p holds the full
   // reduction on this rank.
@@ -819,7 +873,9 @@ int hvd_ring_reducescatter(void* h, void* buf, const long long* counts,
   int64_t max_chunk = 0;
   for (int i = 0; i < p; ++i)
     max_chunk = std::max(max_chunk, static_cast<int64_t>(counts[i]));
-  std::vector<char> tmp(static_cast<size_t>(max_chunk) * es);
+  std::vector<char> tmp;  // non-shm receive hops only (see allreduce)
+  if (left.rx == nullptr || right.tx == nullptr)
+    tmp.resize(static_cast<size_t>(max_chunk) * es);
   // Chunk (me-s-1) was accumulated in the previous step and moves on;
   // the final receive at s = p-2 lands chunk `me` fully reduced here.
   for (int s = 0; s < p - 1; ++s) {

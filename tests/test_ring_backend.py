@@ -374,3 +374,45 @@ print("OK")
 """, nproc=2, timeout=240,
         extra_env={"HOROVOD_RING_SHM_CAP": "4096"})
     assert_all_ok(results)
+
+
+def test_ring_shm_peer_death_fails_promptly():
+    """A same-host peer that hard-dies mid-transfer must surface as a
+    prompt collective failure on the survivor (the shm wait watches
+    the pair's idle TCP socket for EOF — Backoff.fd_dead), never a
+    multi-minute timeout: elastic recovery latency depends on it."""
+    import time
+    t0 = time.monotonic()
+    results = run_workers(_RING_CHECK + """
+import os, threading, time
+import numpy as np
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+assert state.backend.stats.get("ring_shm") is True, state.backend.stats
+# Warm the plane so the death happens on an established ring.
+np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="w"))
+
+big = np.full(16 * 1024 * 1024, float(RANK + 1), np.float32)  # 64 MB
+if RANK == 1:
+    threading.Timer(0.05, lambda: os._exit(1)).start()
+t0 = time.perf_counter()
+try:
+    np.asarray(hvd.allreduce(big, op=hvd.Sum, name="die"))
+    assert RANK == 1, "survivor's collective unexpectedly succeeded"
+except Exception as e:
+    dt = time.perf_counter() - t0
+    print("FAILED-FAST %.1fs %s" % (dt, type(e).__name__), flush=True)
+    assert dt < 30, "detection took %.1fs" % dt
+print("OK")
+""", nproc=2, timeout=240,
+        extra_env={"HOROVOD_RING_SHM_CAP": "65536"})
+    # Rank 1 exits 1 by design.  Rank 0 must observe the failure as a
+    # raised collective error well inside the 300 s shm timeout; its
+    # own exit code may be nonzero too (the job is aborted — shutdown
+    # after a dead peer is fatal-to-job by design, and elastic catches
+    # HorovodInternalError above this layer).
+    elapsed = time.monotonic() - t0
+    rank0 = results[0]
+    assert "FAILED-FAST" in rank0[1] and "OK" in rank0[1], rank0
+    assert elapsed < 120, "survivor took %.0fs — death not detected" \
+        % elapsed
