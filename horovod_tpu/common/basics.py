@@ -165,6 +165,16 @@ def init(comm=None, process_sets=None):
     state = _state()
     with state.init_lock:
         if state.initialized:
+            # Re-init is a no-op for the world, but process sets must
+            # NOT be silently dropped: register them now (the
+            # reference allows post-init registration via
+            # add_process_set; dropping them here left ids at -1 and
+            # sent colliding psid=-1 requests — a measured 4-rank
+            # wedge, tests/test_stress_protocol.py).
+            if process_sets:
+                for ps in process_sets:
+                    if getattr(ps, "process_set_id", -1) in (-1, None):
+                        add_process_set(ps)
             return
         state.knobs = Knobs.from_env()
         if state.knobs.elastic and \

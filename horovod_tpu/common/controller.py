@@ -161,20 +161,29 @@ def construct_response(name: str, msgs: List[Request], size: int,
 @dataclass
 class MessageTable:
     """Pending per-tensor request accumulation on the coordinator
-    (IncrementTensorCount, controller.cc:942-965)."""
-    entries: Dict[str, List[Request]] = field(default_factory=dict)
+    (IncrementTensorCount, controller.cc:942-965).  Keyed by
+    (process_set_id, tensor_name): the SAME tensor name may be in
+    flight on different process sets concurrently — the reference
+    allows this structurally by giving every process set its own
+    controller (process_set.h ProcessSetTable); a name-only key mixes
+    the negotiations and wedges both sets."""
+    entries: Dict[tuple, List[Request]] = field(default_factory=dict)
+
+    @staticmethod
+    def key(req: Request) -> tuple:
+        return (req.process_set_id, req.tensor_name)
 
     def increment(self, req: Request, required: int,
                   joined_count: int = 0) -> bool:
-        msgs = self.entries.setdefault(req.tensor_name, [])
+        msgs = self.entries.setdefault(self.key(req), [])
         msgs.append(req)
         return len(msgs) + joined_count >= required
 
-    def pop(self, name: str) -> List[Request]:
-        return self.entries.pop(name, [])
+    def pop(self, key: tuple) -> List[Request]:
+        return self.entries.pop(key, [])
 
-    def ready_count(self, name: str) -> int:
-        return len(self.entries.get(name, []))
+    def ready_count(self, key: tuple) -> int:
+        return len(self.entries.get(key, []))
 
 
 class Controller:
@@ -213,7 +222,7 @@ class LoopbackController(Controller):
         responses: List[Response] = []
         group_ids = {}
         for req in pending:
-            group_ids[req.tensor_name] = req.group_id
+            group_ids[MessageTable.key(req)] = req.group_id
             if req.request_type == RequestType.JOIN:
                 self.joined_ranks.add(req.request_rank)
                 self.last_joined_rank = req.request_rank

@@ -135,6 +135,17 @@ class CoordinatorServer:
         self._stall_shutdown_s = stall_shutdown_time_s
         self._stall_logged: Dict[str, float] = {}
         self._conns: Dict[int, socket.socket] = {}
+        # Formation gate: NOTHING may be negotiated (and so no frame
+        # broadcast) until every rank of this incarnation has
+        # connected — a response completed among early connectors
+        # would never reach a late one (measured: subgroup-first
+        # traffic wedged/desynced ranks that missed the first RS,
+        # tests/test_stress_protocol.py).  Uplink frames arriving
+        # before formation buffer here and drain, in arrival order,
+        # when the last rank registers.
+        self._formed = size <= 1
+        self._pre_formed: List[tuple] = []  # (kind, rank, payload)
+        self._started_at = time.monotonic()  # formation-stall clock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -203,6 +214,17 @@ class CoordinatorServer:
                                     self._synced_params)
                     except OSError:
                         pass
+                if not self._formed and len(self._conns) >= self.size:
+                    self._formed = True
+                    pre, self._pre_formed = self._pre_formed, []
+                    for kind, r, payload in pre:
+                        if kind == "rq":
+                            self._process(
+                                r, [(req, False) for req in payload])
+                        else:
+                            items = self._resolve_hits(r, payload)
+                            if items:
+                                self._process(r, items)
             with self._departed_cond:
                 self._seen += 1
                 self._departed_cond.notify_all()
@@ -254,8 +276,18 @@ class CoordinatorServer:
         with self._lock:
             self._conns.pop(rank, None)
             self._broken = True
+            # Keys are (psid, name); the ERROR responses must carry
+            # BOTH — workers pop their tensor-table entries by
+            # (name, psid), so an error missing the psid never reaches
+            # a non-global set's blocked submitter.  Pre-formation
+            # buffered requests fail too: their submitters are blocked
+            # just the same.
             pending = list(self._table.entries.keys()) + \
-                list(self._barriers.keys())
+                list(self._barriers.keys()) + \
+                [(req.process_set_id, req.tensor_name)
+                 for kind, _, payload in self._pre_formed
+                 if kind == "rq" for req in payload]
+            self._pre_formed.clear()
             self._table.entries.clear()
             self._barriers.clear()
             self._first_seen.clear()
@@ -266,7 +298,8 @@ class CoordinatorServer:
             logger.info("elastic coordinator: %s", msg)
             responses = [Response(
                 response_type=ResponseType.ERROR, tensor_names=[name],
-                error_message=msg) for name in pending]
+                process_set_id=psid,
+                error_message=msg) for psid, name in pending]
             if responses:
                 self._broadcast_locked(responses)
             # Abort broadcast: a worker with NO pending eager
@@ -295,20 +328,27 @@ class CoordinatorServer:
         """Re-scan the message table for tensors completed by a rank
         joining (the reference fires pending tensors when join
         participation changes, controller.cc:254-308)."""
-        ready: List[Tuple[str, List[Request]]] = []
-        for name in list(self._table.entries.keys()):
-            msgs = self._table.entries[name]
+        ready: List[Tuple[tuple, List[Request]]] = []
+        for key in list(self._table.entries.keys()):
+            msgs = self._table.entries[key]
             if not msgs:
                 continue
             required = self._required_for(msgs[0]) or self.size
             if len(msgs) + self._joined_count_for(msgs[0]) >= required:
-                self._table.pop(name)
-                self._first_seen.pop(name, None)
-                ready.append((name, msgs))
+                self._table.pop(key)
+                self._first_seen.pop(key, None)
+                ready.append((key, msgs))
         return ready
 
     def _handle_requests(self, rank: int, requests: List[Request]):
         with self._lock:
+            # _broken outranks the formation gate: after an elastic
+            # rank loss during formation the gate can never open, and
+            # buffering would hide the failure from the submitter
+            # forever — _process's broken branch errors it instead.
+            if not self._formed and not self._broken:
+                self._pre_formed.append(("rq", rank, requests))
+                return
             self._process(rank, [(req, False) for req in requests])
 
     def _handle_cache_hits(self, rank: int, bits: List[int]):
@@ -316,42 +356,55 @@ class CoordinatorServer:
         elided because its cached signature still matches (reference:
         CacheCoordinator::sync)."""
         with self._lock:
-            items: List[Tuple[Request, bool]] = []
-            for bit in bits:
-                resolved = self._cache.resolve_bit(bit)
-                if resolved is None:
-                    # Only possible if >TOMBSTONE_CAP evictions raced one
-                    # in-flight frame — effectively unreachable; the
-                    # sender's tensor would hang, so fail loudly.
-                    logger.error(
-                        "unresolvable cache bit %d from rank %d; "
-                        "protocol desync", bit, rank)
-                    self._broadcast_locked([Response(
-                        response_type=ResponseType.ERROR,
-                        tensor_names=[f"__cache_bit_{bit}"],
-                        error_message="response-cache protocol desync")])
-                    continue
-                live, name, sig, sizes, gid = resolved
-                first_dim = None
-                if sig[7] == int(RequestType.ALLGATHER) and sizes:
-                    # tensor_sizes are in GROUP order: index by the
-                    # rank's position in the process set when one is
-                    # given; a rank outside the set gets NO override
-                    # (mirrors the native coordinator).
-                    psr = sig[8]
-                    if psr:
-                        idx = psr.index(rank) if rank in psr else -1
-                    else:
-                        idx = rank
-                    if 0 <= idx < len(sizes):
-                        first_dim = sizes[idx]
-                req = signature_to_request(sig, rank, name, first_dim)
-                req.group_id = gid
-                # A tombstoned bit still counts as a contribution, but
-                # forces the full (renegotiation) path.
-                items.append((req, live))
+            if not self._formed and not self._broken:
+                # Unreachable with a fresh cache (no bit precedes the
+                # first RS, which the gate itself blocks) — buffered
+                # for defense in depth.
+                self._pre_formed.append(("ch", rank, bits))
+                return
+            items = self._resolve_hits(rank, bits)
             if items:
                 self._process(rank, items)
+
+    def _resolve_hits(self, rank: int, bits: List[int]
+                      ) -> List[Tuple[Request, bool]]:
+        """Resolve CH bits into requests (caller holds self._lock)."""
+        items: List[Tuple[Request, bool]] = []
+        for bit in bits:
+            resolved = self._cache.resolve_bit(bit)
+            if resolved is None:
+                # Only possible if >TOMBSTONE_CAP evictions raced one
+                # in-flight frame — effectively unreachable; the
+                # sender's tensor would hang, so fail loudly.
+                logger.error(
+                    "unresolvable cache bit %d from rank %d; "
+                    "protocol desync", bit, rank)
+                self._broadcast_locked([Response(
+                    response_type=ResponseType.ERROR,
+                    tensor_names=[f"__cache_bit_{bit}"],
+                    error_message="response-cache protocol desync")])
+                continue
+            live, key, sig, sizes, gid = resolved
+            name = key[1]  # cache keys are (psid, name)
+            first_dim = None
+            if sig[7] == int(RequestType.ALLGATHER) and sizes:
+                # tensor_sizes are in GROUP order: index by the
+                # rank's position in the process set when one is
+                # given; a rank outside the set gets NO override
+                # (mirrors the native coordinator).
+                psr = sig[8]
+                if psr:
+                    idx = psr.index(rank) if rank in psr else -1
+                else:
+                    idx = rank
+                if 0 <= idx < len(sizes):
+                    first_dim = sizes[idx]
+            req = signature_to_request(sig, rank, name, first_dim)
+            req.group_id = gid
+            # A tombstoned bit still counts as a contribution, but
+            # forces the full (renegotiation) path.
+            items.append((req, live))
+        return items
 
     def _process(self, rank: int, items: List[Tuple[Request, bool]]):
         """Accumulate; fire fused broadcasts with everything that became
@@ -364,23 +417,28 @@ class CoordinatorServer:
             self._broadcast_locked([Response(
                 response_type=ResponseType.ERROR,
                 tensor_names=[req.tensor_name],
+                process_set_id=req.process_set_id,
                 error_message="membership changed; collective "
                               "cannot complete")
                 for req, _ in items])
             return
-        ready: List[Tuple[str, Optional[List[Request]], Optional[Response]]] = []
+        # Every per-tensor dict below is keyed by (process_set_id,
+        # name): the same name may be live on two process sets at once
+        # (reference analog: per-set controllers in process_set.h).
+        ready: List[Tuple[tuple, Optional[List[Request]], Optional[Response]]] = []
         for req, from_cache in items:
             name = req.tensor_name
+            key = MessageTable.key(req)
             n = 1
             for d in req.tensor_shape:
                 n *= d
-            self._elem_cache[name] = n
-            self._group_ids[name] = req.group_id
+            self._elem_cache[key] = n
+            self._group_ids[key] = req.group_id
             if req.request_type == RequestType.JOIN:
                 self._joined.add(rank)
                 self._last_joined = rank
                 if len(self._joined) == self.size:
-                    ready.append((name, None, Response(
+                    ready.append((key, None, Response(
                         response_type=ResponseType.JOIN,
                         tensor_names=["join"],
                         last_joined_rank=self._last_joined)))
@@ -392,46 +450,46 @@ class CoordinatorServer:
                     # carry the joined rank's old contribution (e.g.
                     # nonzero allgather row counts) whereas
                     # construct_response records zeros for it.
-                    for cname, msgs in self._scan_complete():
-                        self._bit_only[cname] = False
-                        ready.append((cname, msgs, None))
+                    for ckey, msgs in self._scan_complete():
+                        self._bit_only[ckey] = False
+                        ready.append((ckey, msgs, None))
                 continue
             if req.request_type == RequestType.BARRIER:
                 required = self._required_for(req) or self.size
-                arrived = self._barriers.setdefault(name, set())
+                arrived = self._barriers.setdefault(key, set())
                 arrived.add(rank)
                 if len(arrived) >= required:
-                    del self._barriers[name]
-                    ready.append((name, None, Response(
+                    del self._barriers[key]
+                    ready.append((key, None, Response(
                         response_type=ResponseType.BARRIER,
                         tensor_names=[name],
                         process_set_id=req.process_set_id,
                         process_set_ranks=req.process_set_ranks)))
                 continue
             if not from_cache:
-                self._bit_only[name] = False
-                if self._cache.has(name):
+                self._bit_only[key] = False
+                if self._cache.has(key):
                     # Signature changed on some rank (or it evicted
                     # locally): renegotiate from scratch so the cached
                     # response can never serve a stale shape/dtype
                     # (reference: INVALID → eviction,
                     # response_cache.cc:49-87).
-                    bit = self._cache.evict_name(name)
+                    bit = self._cache.evict_name(key)
                     if bit is not None:
                         self._pending_evictions.append(bit)
             else:
-                self._bit_only.setdefault(name, True)
+                self._bit_only.setdefault(key, True)
             required = self._required_for(req) or self.size
-            self._first_seen.setdefault(name, time.monotonic())
+            self._first_seen.setdefault(key, time.monotonic())
             complete = self._table.increment(
                 req, required,
                 joined_count=self._joined_count_for(req))
             if self.timeline:
                 self.timeline.negotiate_rank_ready(name, rank)
             if complete:
-                msgs = self._table.pop(name)
-                self._first_seen.pop(name, None)
-                ready.append((name, msgs, None))
+                msgs = self._table.pop(key)
+                self._first_seen.pop(key, None)
+                ready.append((key, msgs, None))
         if not ready:
             self._flush_evictions_locked()
             return
@@ -442,59 +500,63 @@ class CoordinatorServer:
         # atomicity): if any member renegotiates, every member of that
         # group is demoted to the full path this round.
         full_groups: Set[int] = set()
-        for name, msgs, direct in ready:
+        for key, msgs, direct in ready:
             if direct is None and not (
-                    self._bit_only.get(name, False) and
-                    self._cache.has(name)):
-                gid = self._group_ids.get(name, -1)
+                    self._bit_only.get(key, False) and
+                    self._cache.has(key)):
+                gid = self._group_ids.get(key, -1)
                 if gid >= 0:
                     full_groups.add(gid)
         hit_responses: List[Response] = []
         full_responses: List[Response] = []
-        sig_by_name: Dict[str, tuple] = {}
-        for name, msgs, direct in ready:
+        sig_by_key: Dict[tuple, tuple] = {}
+        for key, msgs, direct in ready:
             if direct is not None:
                 full_responses.append(direct)
                 continue
-            bit_only = self._bit_only.pop(name, False)
-            self._stall_logged.pop(name, None)
-            ent = self._cache.get(name)
+            bit_only = self._bit_only.pop(key, False)
+            self._stall_logged.pop(key, None)
+            ent = self._cache.get(key)
             # While any rank is joined, cached responses are stale for
             # it (renegotiation substitutes zeros for joined ranks) —
             # bypass the fast path entirely.
             if bit_only and ent is not None and not self._joined and \
-                    self._group_ids.get(name, -1) not in full_groups:
+                    self._group_ids.get(key, -1) not in full_groups:
                 hit_responses.append(ent[1])
                 self.stats["fast_tensors"] += 1
                 continue
-            resp = construct_response(name, msgs, self.size, self._joined)
-            sig_by_name[name] = request_signature(msgs[0])
+            resp = construct_response(msgs[0].tensor_name, msgs,
+                                      self.size, self._joined)
+            sig_by_key[key] = request_signature(msgs[0])
             full_responses.append(resp)
             self.stats["negotiated_tensors"] += 1
-            self._cache.clear_tombstones_for(name)
+            self._cache.clear_tombstones_for(key)
 
         nbytes = 0
         if hit_responses:
             fused_hits = fuse_responses(
                 hit_responses, self._elem_cache, self.fusion_threshold,
                 self._group_ids)
-            batches = [[self._cache.get(n)[0] for n in fr.tensor_names]
+            batches = [[self._cache.get((fr.process_set_id, n))[0]
+                        for n in fr.tensor_names]
                        for fr in fused_hits]
             payload = pack_bit_batches(batches)
             self._broadcast_frame_locked(_MAGIC_CACHE, payload)
             self.stats["fast_rounds"] += 1
-            nbytes += sum(self._elem_cache.get(n, 0) *
+            nbytes += sum(self._elem_cache.get((fr.process_set_id, n),
+                                               0) *
                           dtype_size(fr.tensor_type)
                           for fr in fused_hits for n in fr.tensor_names)
         if full_responses:
             fused = fuse_responses(full_responses, self._elem_cache,
                                    self.fusion_threshold, self._group_ids)
             if self._cache.enabled:
-                self._assign_cache_bits(fused, sig_by_name)
+                self._assign_cache_bits(fused, sig_by_key)
             self._flush_evictions_locked()
             self._broadcast_locked(fused)
             self.stats["full_rounds"] += 1
-            nbytes += sum(self._elem_cache.get(n, 0) *
+            nbytes += sum(self._elem_cache.get((fr.process_set_id, n),
+                                               0) *
                           dtype_size(fr.tensor_type)
                           for fr in fused for n in fr.tensor_names)
         else:
@@ -532,7 +594,7 @@ class CoordinatorServer:
         self._broadcast_frame_locked(_MAGIC_PARAMS, payload)
 
     def _assign_cache_bits(self, fused: List[Response],
-                           sig_by_name: Dict[str, tuple]):
+                           sig_by_key: Dict[tuple, tuple]):
         """Seed the cache from freshly negotiated responses and stamp
         the coordinator-assigned bits onto the wire."""
         pending = set(self._table.entries.keys())
@@ -542,12 +604,13 @@ class CoordinatorServer:
             parts = split_response(resp, self.size)
             bits = []
             for i, name in enumerate(resp.tensor_names):
-                sig = sig_by_name.get(name)
+                key = (resp.process_set_id, name)
+                sig = sig_by_key.get(key)
                 if sig is None:
                     bits.append(-1)
                     continue
                 bit, evicted = self._cache.insert(
-                    name, parts[i], sig, self._group_ids.get(name, -1),
+                    key, parts[i], sig, self._group_ids.get(key, -1),
                     pending)
                 bits.append(bit)
                 self._pending_evictions.extend(evicted)
@@ -573,33 +636,71 @@ class CoordinatorServer:
     # stall attribution (reference stall_inspector.{h,cc}: rank-0 names
     # which ranks submitted a tensor and which did not)
     # ------------------------------------------------------------------
+    def _check_formation_stall(self):
+        """Pre-formation requests never enter the message table, so
+        the per-tensor stall report is blind to a rank that crashes
+        before connecting — attribute THAT stall here, and past the
+        shutdown threshold fail the buffered collectives (the failure
+        class the stall machinery exists for)."""
+        with self._lock:
+            if self._formed or not self._pre_formed:
+                return
+            age = time.monotonic() - self._started_at
+            if age < self._stall_warning_s:
+                return
+            missing = sorted(set(range(self.size)) -
+                             set(self._conns.keys()))
+            last = self._stall_logged.get(("__formation__",), 0.0)
+            if age - last >= self._stall_warning_s or last == 0:
+                self._stall_logged[("__formation__",)] = age
+                logger.warning(
+                    "STALL: waiting for ranks %s to connect for %.0fs "
+                    "(%d/%d registered, %d requests buffered)",
+                    missing, age, len(self._conns), self.size,
+                    len(self._pre_formed))
+            if 0 < self._stall_shutdown_s <= age:
+                pre, self._pre_formed = self._pre_formed, []
+                errs = [Response(
+                    response_type=ResponseType.ERROR,
+                    tensor_names=[req.tensor_name],
+                    process_set_id=req.process_set_id,
+                    error_message=(
+                        "ranks %s never connected within %.0fs"
+                        % (missing, self._stall_shutdown_s)))
+                    for kind, _, payload in pre if kind == "rq"
+                    for req in payload]
+                if errs:
+                    self._broadcast_locked(errs)
+
     def stall_report(self) -> List[Tuple[str, List[int], List[int], float]]:
         """(tensor, submitted_ranks, missing_ranks, age_s) for every
         tensor pending longer than the warning threshold."""
         now = time.monotonic()
         out = []
         with self._lock:
-            for name, msgs in self._table.entries.items():
+            for key, msgs in self._table.entries.items():
                 if not msgs:
                     continue
-                ts = self._first_seen.get(name)
+                ts = self._first_seen.get(key)
                 if ts is None or now - ts < self._stall_warning_s:
                     continue
                 submitted = sorted({m.request_rank for m in msgs})
                 members = msgs[0].process_set_ranks or range(self.size)
                 missing = sorted(set(members) - set(submitted)
                                  - self._joined)
-                out.append((name, submitted, missing, now - ts))
+                out.append((key, submitted, missing, now - ts))
         return out
 
     def _stall_loop(self):
         interval = max(min(self._stall_warning_s / 2.0, 10.0), 0.25)
         while not self._stop.wait(interval):
-            for name, submitted, missing, age in self.stall_report():
-                last = self._stall_logged.get(name, 0.0)
+            self._check_formation_stall()
+            for key, submitted, missing, age in self.stall_report():
+                name = key[1]
+                last = self._stall_logged.get(key, 0.0)
                 if age - last < self._stall_warning_s and last > 0:
                     continue
-                self._stall_logged[name] = age
+                self._stall_logged[key] = age
                 logger.warning(
                     "STALL: tensor %s — ranks %s submitted, ranks %s "
                     "have not, for %.0fs. One or more ranks may be "
@@ -611,13 +712,14 @@ class CoordinatorServer:
                         "(%.0fs); failing the collective", name,
                         self._stall_shutdown_s)
                     with self._lock:
-                        msgs = self._table.pop(name)
-                        self._first_seen.pop(name, None)
-                        self._bit_only.pop(name, None)
+                        msgs = self._table.pop(key)
+                        self._first_seen.pop(key, None)
+                        self._bit_only.pop(key, None)
                         if msgs:
                             self._broadcast_locked([Response(
                                 response_type=ResponseType.ERROR,
                                 tensor_names=[name],
+                                process_set_id=key[0],
                                 error_message=(
                                     f"collective {name} stalled: ranks "
                                     f"{missing} never submitted it "
@@ -652,7 +754,10 @@ class NetworkController(Controller):
         # Worker-side response cache (fast-path uplink/downlink); the
         # coordinator owns bit assignment, we just follow the RS frames.
         self.cache = WorkerResponseCache(state.knobs.cache_capacity)
-        self._sent_sigs: Dict[str, tuple] = {}
+        self._sent_sigs: Dict[tuple, tuple] = {}  # (psid, name) -> sig
+        # Bounded cache-seed diagnostics (read on desync only).
+        from collections import deque
+        self._seed_log = deque(maxlen=64)
         self.stats = {"rq_frames": 0, "ch_frames": 0, "rs_frames": 0,
                       "cb_frames": 0, "ev_frames": 0, "pa_frames": 0,
                       "bytes_sent": 0, "bytes_recv": 0}
@@ -937,14 +1042,21 @@ class NetworkController(Controller):
             return
         for resp in responses:
             if resp.response_type not in CACHEABLE or not resp.cache_bits:
+                self._seed_log.append(
+                    ("skip", resp.tensor_names, resp.process_set_id,
+                     list(resp.cache_bits or ())))
                 continue
             parts = split_response(resp, self.size)
             for i, name in enumerate(resp.tensor_names):
                 bit = resp.cache_bits[i] if i < len(resp.cache_bits) else -1
                 if bit < 0:
+                    self._seed_log.append(("nobit", name,
+                                           resp.process_set_id))
                     continue
-                self.cache.insert(name, bit, parts[i],
-                                  self._sent_sigs.get(name))
+                key = (resp.process_set_id, name)
+                self._seed_log.append(("seed", bit, key))
+                self.cache.insert(key, bit, parts[i],
+                                  self._sent_sigs.get(key))
 
     def _reconstruct_cached(self, batches: List[List[int]]
                             ) -> Optional[List[Response]]:
@@ -957,9 +1069,15 @@ class NetworkController(Controller):
             parts = [self.cache.response_for_bit(b) for b in batch]
             if any(p is None for p in parts):
                 from .exceptions import HorovodInternalError
+                missing = [b for b, p in zip(batch, parts) if p is None]
                 self._set_broken(HorovodInternalError(
-                    "response-cache desync: coordinator referenced a "
-                    "cache bit this rank does not hold"))
+                    "response-cache desync: coordinator referenced "
+                    "cache bit(s) %s this rank does not hold (batch "
+                    "%s; held: %s; frames: %s; seeds: %s)" % (
+                        missing, batch, self.cache.debug_bits(),
+                        {k: v for k, v in self.stats.items()
+                         if k.endswith("_frames")},
+                        list(self._seed_log)[-12:])))
                 return None
             responses.append(merge_responses(parts))
         return responses
@@ -1018,7 +1136,8 @@ class NetworkController(Controller):
                     hit_bits.append(bit)
                 else:
                     full.append(req)
-                    self._sent_sigs[req.tensor_name] = \
+                    self._sent_sigs[(req.process_set_id,
+                                     req.tensor_name)] = \
                         request_signature(req)
             try:
                 with self._send_lock:

@@ -23,10 +23,13 @@ _FUSABLE = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
 
 
 def response_bytes(resp: Response, entry_sizes) -> int:
-    """Total payload bytes of a response given per-tensor element counts."""
+    """Total payload bytes of a response given per-tensor element
+    counts.  ``entry_sizes`` is keyed by (process_set_id, name): the
+    same name may be live on two process sets with different shapes."""
     total = 0
     for name in resp.tensor_names:
-        total += entry_sizes[name] * dtype_size(resp.tensor_type)
+        total += entry_sizes[(resp.process_set_id, name)] * \
+            dtype_size(resp.tensor_type)
     return total
 
 
@@ -72,7 +75,8 @@ def _premerge_groups(responses: List[Response], group_ids) -> List[Response]:
     for resp in responses:
         gid = -1
         if resp.tensor_names and group_ids:
-            gid = group_ids.get(resp.tensor_names[0], -1)
+            gid = group_ids.get(
+                (resp.process_set_id, resp.tensor_names[0]), -1)
         if gid < 0 or resp.response_type not in _FUSABLE:
             merged.append(resp)
             continue

@@ -169,14 +169,17 @@ class WorkerResponseCache:
     def lookup_bit(self, req: Request) -> Optional[int]:
         """Bit for a HIT, else None.  A signature mismatch (INVALID)
         drops the local entry so the full request goes out and the
-        coordinator renegotiates."""
+        coordinator renegotiates.  Entries are keyed by
+        (process_set_id, name) — the same name may be cached for two
+        process sets at once."""
+        key = (req.process_set_id, req.tensor_name)
         with self._lock:
-            ent = self._entries.get(req.tensor_name)
+            ent = self._entries.get(key)
             if ent is None:
                 return None
             bit, _, sig = ent
             if sig is None or sig != request_signature(req):
-                del self._entries[req.tensor_name]
+                del self._entries[key]
                 self._bit_names.pop(bit, None)
                 return None
             return bit
@@ -203,6 +206,11 @@ class WorkerResponseCache:
                 name = self._bit_names.pop(b, None)
                 if name is not None:
                     self._entries.pop(name, None)
+
+    def debug_bits(self):
+        """bit -> key snapshot for desync diagnostics."""
+        with self._lock:
+            return dict(sorted(self._bit_names.items()))
 
     def __len__(self):
         with self._lock:

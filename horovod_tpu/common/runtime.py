@@ -65,7 +65,7 @@ class BackgroundRuntime:
             self.controller.set_receive_callback(self._wake.set)
         self._thread: Optional[threading.Thread] = None
         self._cycle_time_s = state.knobs.cycle_time_ms / 1000.0
-        self._entry_sizes: Dict[str, int] = {}
+        self._entry_sizes: Dict[tuple, int] = {}  # (psid, name)
         self._joined = False
         self._error: Optional[Exception] = None
         # Called once when a fatal control-plane error surfaces (e.g.
@@ -105,7 +105,8 @@ class BackgroundRuntime:
         nelem = 1
         for d in request.tensor_shape:
             nelem *= d
-        self._entry_sizes[request.tensor_name] = nelem
+        self._entry_sizes[(request.process_set_id,
+                           request.tensor_name)] = nelem
         if self.timeline:
             self.timeline.negotiate_start(
                 request.tensor_name, request.request_type.name)
@@ -157,7 +158,8 @@ class BackgroundRuntime:
             nelem = 1
             for d in request.tensor_shape:
                 nelem *= d
-            self._entry_sizes[request.tensor_name] = nelem
+            self._entry_sizes[(request.process_set_id,
+                               request.tensor_name)] = nelem
         self.tensor_queue.add_multi(requests, entries)
         self._wake.set()
 

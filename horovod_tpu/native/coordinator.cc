@@ -47,6 +47,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <list>
@@ -486,6 +487,27 @@ Request sig_to_request(const Sig& s, int rank, const std::string& name,
 
 // Coordinator-side response cache with authoritative, monotonically
 // increasing bit assignment (see response_cache.py CoordinatorCache).
+// Per-tensor coordinator state (message table, caches, stall clocks)
+// is keyed by process set AND name: the same tensor name may be in
+// flight on two process sets at once (the reference allows this
+// structurally — every process set owns its own controller,
+// process_set.h ProcessSetTable).  Key format "<psid>\x1f<name>";
+// \x1f cannot appear in the psid digits, so the FIRST separator
+// always recovers the pure wire name even if the name itself
+// contains \x1f.
+inline std::string ps_key(int32_t psid, const std::string& name) {
+  return std::to_string(psid) + '\x1f' + name;
+}
+inline std::string pure_name(const std::string& key) {
+  auto pos = key.find('\x1f');
+  return pos == std::string::npos ? key : key.substr(pos + 1);
+}
+inline int32_t key_psid(const std::string& key) {
+  auto pos = key.find('\x1f');
+  if (pos == std::string::npos) return 0;
+  return int32_t(std::atoi(key.substr(0, pos).c_str()));
+}
+
 class CoordCache {
  public:
   struct Entry {
@@ -644,6 +666,7 @@ class Coordinator {
         fusion_threshold_(fusion_threshold),
         elastic_(elastic),
         cache_(cache_capacity),
+        formed_(size <= 1),
         stall_warn_s_(stall_warn_s),
         stall_shutdown_s_(stall_shutdown_s) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -750,7 +773,8 @@ class Coordinator {
       std::snprintf(line, sizeof(line),
                     "STALL: tensor %s - ranks [%s] submitted, ranks "
                     "[%s] have not, for %.0fs\n",
-                    kv.first.c_str(), sub.c_str(), miss.c_str(), age);
+                    pure_name(kv.first).c_str(), sub.c_str(),
+                    miss.c_str(), age);
       out += line;
     }
     return out;
@@ -803,6 +827,22 @@ class Coordinator {
       {
         std::lock_guard<std::mutex> g(mu_);
         conns_[rank] = conn;
+        if (!formed_ && int(conns_.size()) >= size_) {
+          formed_ = true;
+          std::vector<PreItem> pre;
+          pre.swap(pre_formed_);
+          for (auto& p : pre) {
+            if (p.is_hits) {
+              HandleCacheHitsLocked(p.rank, p.bits);
+            } else {
+              std::vector<std::pair<Request, bool>> items;
+              items.reserve(p.reqs.size());
+              for (auto& r : p.reqs) items.emplace_back(std::move(r),
+                                                        false);
+              Process(p.rank, items);
+            }
+          }
+        }
       }
       {
         std::lock_guard<std::mutex> g(departed_mu_);
@@ -914,7 +954,7 @@ class Coordinator {
   int64_t ResponseBytes(const Response& r) {
     int64_t total = 0;
     for (const auto& n : r.names) {
-      auto it = elem_cache_.find(n);
+      auto it = elem_cache_.find(ps_key(r.psid, n));
       int64_t elems = it == elem_cache_.end() ? 0 : it->second;
       total += elems * kDtypeSize[r.dtype];
     }
@@ -948,7 +988,7 @@ class Coordinator {
     for (auto& resp : in) {
       int32_t gid = -1;
       if (!resp.names.empty()) {
-        auto it = group_ids_.find(resp.names[0]);
+        auto it = group_ids_.find(ps_key(resp.psid, resp.names[0]));
         if (it != group_ids_.end()) gid = it->second;
       }
       if (gid < 0 || !kFusable.count(resp.type)) {
@@ -1029,6 +1069,17 @@ class Coordinator {
 
   void HandleRequests(int rank, const std::vector<Request>& reqs) {
     std::lock_guard<std::mutex> g(mu_);
+    if (!formed_ && !broken_) {
+      // Formation gate: a response completed among early connectors
+      // would never reach a not-yet-connected rank (broadcast goes to
+      // conns_ only) — buffer until every rank registered (drained in
+      // arrival order by AcceptLoop; mirrors controller_net.py).
+      PreItem p;
+      p.rank = rank;
+      p.reqs = reqs;
+      pre_formed_.push_back(std::move(p));
+      return;
+    }
     std::vector<std::pair<Request, bool>> items;
     items.reserve(reqs.size());
     for (const auto& r : reqs) items.emplace_back(r, false);
@@ -1037,6 +1088,18 @@ class Coordinator {
 
   void HandleCacheHits(int rank, const std::vector<int32_t>& bits) {
     std::lock_guard<std::mutex> g(mu_);
+    if (!formed_ && !broken_) {  // defense; no bit precedes 1st RS
+      PreItem p;
+      p.rank = rank;
+      p.is_hits = true;
+      p.bits = bits;
+      pre_formed_.push_back(std::move(p));
+      return;
+    }
+    HandleCacheHitsLocked(rank, bits);
+  }
+
+  void HandleCacheHitsLocked(int rank, const std::vector<int32_t>& bits) {
     std::vector<std::pair<Request, bool>> items;
     for (int32_t bit : bits) {
       std::string name;
@@ -1044,6 +1107,7 @@ class Coordinator {
       std::vector<int64_t> sizes;
       int32_t gid;
       int state = cache_.resolve_bit(bit, &name, &sig, &sizes, &gid);
+      name = pure_name(name);  // cache keys are ps_key(psid, name)
       if (state == 0) {
         std::fprintf(stderr,
                      "[hvd-coord] unresolvable cache bit %d from rank "
@@ -1083,6 +1147,7 @@ class Coordinator {
         Response r;
         r.type = RESP_ERROR;
         r.names = {it.first.name};
+        r.psid = it.first.psid;
         r.error = "membership changed; collective cannot complete";
         errs.push_back(std::move(r));
       }
@@ -1093,7 +1158,8 @@ class Coordinator {
     // one ordered list so the broadcast interleaves them exactly as
     // they completed (matching controller_net.py's ready list).
     struct ReadyItem {
-      std::string name;
+      std::string name;           // pure wire name
+      std::string key;            // ps_key(psid, name)
       std::vector<Request> msgs;  // empty for direct responses
       bool is_direct = false;
       Response direct;
@@ -1102,10 +1168,11 @@ class Coordinator {
     for (const auto& item : items) {
       const Request& req = item.first;
       bool from_cache = item.second;
+      const std::string key = ps_key(req.psid, req.name);
       int64_t n = 1;
       for (int64_t d : req.shape) n *= d;
-      elem_cache_[req.name] = n;
-      group_ids_[req.name] = req.group_id;
+      elem_cache_[key] = n;
+      group_ids_[key] = req.group_id;
       if (req.type == REQ_JOIN) {
         joined_.insert(rank);
         last_joined_ = rank;
@@ -1123,7 +1190,8 @@ class Coordinator {
           ScanComplete(&scanned);
           for (auto& kv : scanned) {
             ReadyItem ri;
-            ri.name = std::move(kv.first);
+            ri.key = std::move(kv.first);
+            ri.name = kv.second[0].name;
             ri.msgs = std::move(kv.second);
             ready.push_back(std::move(ri));
           }
@@ -1132,10 +1200,10 @@ class Coordinator {
       }
       if (req.type == REQ_BARRIER) {
         int required = RequiredFor(req);
-        auto& arrived = barriers_[req.name];
+        auto& arrived = barriers_[key];
         arrived.insert(rank);
         if (int(arrived.size()) >= required) {
-          barriers_.erase(req.name);
+          barriers_.erase(key);
           ReadyItem ri;
           ri.is_direct = true;
           ri.direct.type = RESP_BARRIER;
@@ -1147,28 +1215,29 @@ class Coordinator {
         continue;
       }
       if (!from_cache) {
-        bit_only_[req.name] = false;
-        if (cache_.has(req.name)) {
+        bit_only_[key] = false;
+        if (cache_.has(key)) {
           // Signature changed on some rank (or worker-side
           // invalidation): renegotiate so a stale response can never
           // serve.
-          int32_t bit = cache_.evict_name(req.name);
+          int32_t bit = cache_.evict_name(key);
           if (bit >= 0) pending_evictions_.push_back(bit);
         }
-      } else if (!bit_only_.count(req.name)) {
-        bit_only_[req.name] = true;
+      } else if (!bit_only_.count(key)) {
+        bit_only_[key] = true;
       }
       int required = RequiredFor(req);
-      if (!first_seen_.count(req.name))
-        first_seen_[req.name] = std::chrono::steady_clock::now();
-      auto& msgs = table_[req.name];
+      if (!first_seen_.count(key))
+        first_seen_[key] = std::chrono::steady_clock::now();
+      auto& msgs = table_[key];
       msgs.push_back(req);
       if (int(msgs.size()) + JoinedCountFor(req) >= required) {
         ReadyItem ri;
         ri.name = req.name;
+        ri.key = key;
         ri.msgs = std::move(msgs);
-        table_.erase(req.name);
-        first_seen_.erase(req.name);
+        table_.erase(key);
+        first_seen_.erase(key);
         ready.push_back(std::move(ri));
       }
     }
@@ -1184,10 +1253,10 @@ class Coordinator {
     std::set<int32_t> full_gids;
     for (const auto& ri : ready) {
       if (ri.is_direct) continue;
-      auto bo = bit_only_.find(ri.name);
+      auto bo = bit_only_.find(ri.key);
       bool bit_only = bo != bit_only_.end() && bo->second;
-      if (!(bit_only && cache_.get(ri.name) != nullptr)) {
-        auto git = group_ids_.find(ri.name);
+      if (!(bit_only && cache_.get(ri.key) != nullptr)) {
+        auto git = group_ids_.find(ri.key);
         if (git != group_ids_.end() && git->second >= 0)
           full_gids.insert(git->second);
       }
@@ -1203,15 +1272,16 @@ class Coordinator {
         continue;
       }
       const std::string& name = ri.name;
+      const std::string& key = ri.key;
       bool bit_only = false;
-      auto bo = bit_only_.find(name);
+      auto bo = bit_only_.find(key);
       if (bo != bit_only_.end()) {
         bit_only = bo->second;
         bit_only_.erase(bo);
       }
-      CoordCache::Entry* ent = cache_.get(name);
+      CoordCache::Entry* ent = cache_.get(key);
       int32_t gid = -1;
-      auto git = group_ids_.find(name);
+      auto git = group_ids_.find(key);
       if (git != group_ids_.end()) gid = git->second;
       // While any rank is joined, cached responses are stale for it
       // (renegotiation substitutes zeros for joined ranks) — bypass
@@ -1222,9 +1292,9 @@ class Coordinator {
         continue;
       }
       Response resp = construct_response(name, ri.msgs, size_);
-      sig_by_name[name] = make_sig(ri.msgs[0]);
+      sig_by_name[key] = make_sig(ri.msgs[0]);
       full_responses.push_back(std::move(resp));
-      cache_.clear_tombstones_for(name);
+      cache_.clear_tombstones_for(key);
     }
 
     int64_t nbytes = 0;
@@ -1234,7 +1304,7 @@ class Coordinator {
       for (const auto& fr : fused_hits) {
         std::vector<int32_t> batch;
         for (const auto& n : fr.names) {
-          CoordCache::Entry* e = cache_.get(n);
+          CoordCache::Entry* e = cache_.get(ps_key(fr.psid, n));
           batch.push_back(e ? e->bit : -1);
         }
         batches.push_back(std::move(batch));
@@ -1278,7 +1348,8 @@ class Coordinator {
         per_sizes = group;
       resp.cache_bits.clear();
       for (size_t i = 0; i < resp.names.size(); ++i) {
-        auto sit = sig_by_name.find(resp.names[i]);
+        const std::string key = ps_key(resp.psid, resp.names[i]);
+        auto sit = sig_by_name.find(key);
         if (sit == sig_by_name.end()) {
           resp.cache_bits.push_back(-1);
           continue;
@@ -1299,12 +1370,50 @@ class Coordinator {
           part.sizes = resp.sizes;
         if (i < resp.shapes.size()) part.shapes = {resp.shapes[i]};
         part.psr = resp.psr;
-        auto git = group_ids_.find(resp.names[i]);
+        auto git = group_ids_.find(key);
         int32_t gid = git == group_ids_.end() ? -1 : git->second;
-        int32_t bit = cache_.insert(resp.names[i], part, sit->second,
+        int32_t bit = cache_.insert(key, part, sit->second,
                                     gid, pending, &pending_evictions_);
         resp.cache_bits.push_back(bit);
       }
+    }
+  }
+
+  // Pre-formation requests never enter table_, so StallReport is
+  // blind to a rank that dies before connecting — attribute that
+  // stall here and, past the shutdown threshold, fail the buffered
+  // collectives (mirrors controller_net.py _check_formation_stall).
+  void CheckFormationStall() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (formed_ || pre_formed_.empty()) return;
+    double age = std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - started_at_).count();
+    if (age < stall_warn_s_) return;
+    std::string miss;
+    for (int r = 0; r < size_; ++r)
+      if (!conns_.count(r)) miss += std::to_string(r) + ",";
+    if (!miss.empty()) miss.pop_back();
+    std::fprintf(stderr,
+                 "STALL: waiting for ranks [%s] to connect for %.0fs "
+                 "(%zu/%d registered, %zu requests buffered)\n",
+                 miss.c_str(), age, conns_.size(), size_,
+                 pre_formed_.size());
+    if (stall_shutdown_s_ > 0 && age >= stall_shutdown_s_) {
+      std::vector<PreItem> pre;
+      pre.swap(pre_formed_);
+      std::vector<Response> errs;
+      for (auto& p : pre) {
+        for (auto& rq : p.reqs) {
+          Response r;
+          r.type = RESP_ERROR;
+          r.names = {rq.name};
+          r.psid = rq.psid;
+          r.error = "ranks [" + miss + "] never connected within " +
+                    std::to_string(int(stall_shutdown_s_)) + "s";
+          errs.push_back(std::move(r));
+        }
+      }
+      if (!errs.empty()) BroadcastLocked(errs);
     }
   }
 
@@ -1316,6 +1425,7 @@ class Coordinator {
     while (!stop_.load()) {
       stall_cv_.wait_for(lk, std::chrono::duration<double>(interval));
       if (stop_.load()) return;
+      CheckFormationStall();
       auto report = StallReport();
       if (!report.empty()) std::fprintf(stderr, "%s", report.c_str());
       if (stall_shutdown_s_ <= 0) continue;
@@ -1330,14 +1440,15 @@ class Coordinator {
             std::chrono::duration<double>(now - ts->second).count();
         if (age >= stall_shutdown_s_) doomed.push_back(kv.first);
       }
-      for (const auto& name : doomed) {
-        table_.erase(name);
-        first_seen_.erase(name);
-        bit_only_.erase(name);
+      for (const auto& key : doomed) {
+        table_.erase(key);
+        first_seen_.erase(key);
+        bit_only_.erase(key);
         Response r;
         r.type = RESP_ERROR;
-        r.names = {name};
-        r.error = "collective " + name +
+        r.names = {pure_name(key)};
+        r.psid = key_psid(key);  // workers pop entries by (name, psid)
+        r.error = "collective " + pure_name(key) +
                   " stalled past the shutdown threshold";
         BroadcastLocked({r});
       }
@@ -1361,17 +1472,30 @@ class Coordinator {
     for (auto& kv : table_) {
       Response r;
       r.type = RESP_ERROR;
-      r.names = {kv.first};
+      r.names = {pure_name(kv.first)};
+      r.psid = key_psid(kv.first);
       r.error = msg;
       errs.push_back(std::move(r));
     }
     for (auto& kv : barriers_) {
       Response r;
       r.type = RESP_ERROR;
-      r.names = {kv.first};
+      r.names = {pure_name(kv.first)};
+      r.psid = key_psid(kv.first);
       r.error = msg;
       errs.push_back(std::move(r));
     }
+    for (auto& p : pre_formed_) {  // pre-formation buffered submitters
+      for (auto& rq : p.reqs) {
+        Response r;
+        r.type = RESP_ERROR;
+        r.names = {rq.name};
+        r.psid = rq.psid;
+        r.error = msg;
+        errs.push_back(std::move(r));
+      }
+    }
+    pre_formed_.clear();
     table_.clear();
     barriers_.clear();
     first_seen_.clear();
@@ -1401,6 +1525,18 @@ class Coordinator {
   std::vector<std::thread> rank_threads_;
 
   std::mutex mu_;
+  // Formation gate: uplink frames buffered until every rank connects
+  // (see HandleRequests).
+  struct PreItem {
+    int rank = -1;
+    bool is_hits = false;
+    std::vector<Request> reqs;
+    std::vector<int32_t> bits;
+  };
+  bool formed_ = false;
+  std::vector<PreItem> pre_formed_;
+  std::chrono::steady_clock::time_point started_at_ =
+      std::chrono::steady_clock::now();
   std::map<int, int> conns_;                      // rank -> fd
   std::map<std::string, std::vector<Request>> table_;
   std::map<std::string, std::set<int>> barriers_;

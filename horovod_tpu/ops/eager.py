@@ -98,6 +98,15 @@ def _submit(request_type: RequestType, tensor, name: str, *, reduce_op=Sum,
             root_rank=-1, prescale=1.0, postscale=1.0, splits=None,
             process_set: ProcessSet = global_process_set) -> Handle:
     runtime = _runtime()
+    if process_set.process_set_id is None or \
+            process_set.process_set_id < 0:
+        # An unregistered set has no coordinator identity; letting the
+        # request out with psid=-1 collides with every other
+        # unregistered set's tensors and wedges the job.
+        raise ValueError(
+            "process set %r is not registered: pass it to "
+            "hvd.init(process_sets=[...]) or call "
+            "hvd.add_process_set(ps) first" % (process_set,))
     handle = Handle(name)
     # Shapeless inputs (python lists/scalars) are normalized to numpy
     # up front: the request must report their REAL shape/dtype (the

@@ -64,7 +64,7 @@ def test_worker_cache_hit_and_invalidate():
     resp = Response(response_type=ResponseType.ALLREDUCE,
                     tensor_names=["t"], tensor_shapes=[(4,)])
     assert cache.lookup_bit(req) is None
-    cache.insert("t", 7, resp, request_signature(req))
+    cache.insert((0, "t"), 7, resp, request_signature(req))
     assert cache.lookup_bit(req) == 7
     assert cache.response_for_bit(7).tensor_names == ["t"]
     # Signature change (shape) invalidates and drops the entry.
@@ -138,16 +138,17 @@ def test_coordinator_cache_tombstones():
                   tensor_type=DataType.FLOAT32)
     resp = Response(response_type=ResponseType.ALLREDUCE,
                     tensor_names=["t"], tensor_shapes=[(4,)])
-    bit, evicted = cache.insert("t", resp, request_signature(req), -1)
+    bit, evicted = cache.insert((0, "t"), resp,
+                                request_signature(req), -1)
     assert evicted == []
-    live, name, sig, _, _ = cache.resolve_bit(bit)
-    assert live and name == "t"
-    # Eviction by name leaves a resolvable tombstone (late CH race).
-    freed = cache.evict_name("t")
+    live, key, sig, _, _ = cache.resolve_bit(bit)
+    assert live and key == (0, "t")
+    # Eviction by key leaves a resolvable tombstone (late CH race).
+    freed = cache.evict_name((0, "t"))
     assert freed == bit
-    live, name, sig, _, _ = cache.resolve_bit(bit)
-    assert not live and name == "t"
-    cache.clear_tombstones_for("t")
+    live, key, sig, _, _ = cache.resolve_bit(bit)
+    assert not live and key == (0, "t")
+    cache.clear_tombstones_for((0, "t"))
     assert cache.resolve_bit(bit) is None
 
 
@@ -161,8 +162,8 @@ def test_group_fusion_atomic_past_threshold():
                           tensor_names=[f"g.{i}"],
                           tensor_type=DataType.FLOAT32,
                           tensor_shapes=[(1024,)]) for i in range(4)]
-    entry_sizes = {f"g.{i}": 1024 for i in range(4)}
-    group_ids = {f"g.{i}": 5 for i in range(4)}
+    entry_sizes = {(0, f"g.{i}"): 1024 for i in range(4)}
+    group_ids = {(0, f"g.{i}"): 5 for i in range(4)}
     # Threshold fits only one tensor (4 KiB): without group atomicity
     # this splits into 4 responses.
     fused = fuse_responses(responses, entry_sizes, threshold_bytes=4096,
@@ -440,7 +441,7 @@ def test_partial_hit_set_nproc4(native):
             # misconfiguration, advisor r2 finding 3): drop the local
             # entry so this rank sends a full request while the other
             # three send bits.
-            ent = ctrl.cache._entries.get("t")
+            ent = ctrl.cache._entries.get((0, "t"))  # (psid, name)
             assert ent is not None
             ctrl.cache.evict_bits([ent[0]])
         y = np.asarray(hvd.allreduce(
