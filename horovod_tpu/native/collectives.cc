@@ -37,6 +37,7 @@
 //   int   hvd_ring_shm_setup(void*, const char* name_prefix,
 //                            long long chan_cap, const int* hostids);
 //   void  hvd_ring_shm_enable(void*);
+//   void  hvd_ring_shm_unlink_name(void*);
 //   int   hvd_ring_shm_active(void*);
 //   void  hvd_ring_destroy(void*);
 //
@@ -673,6 +674,20 @@ void hvd_ring_shm_enable(void* h) {
   if (c->shm_base != nullptr) c->shm_on = true;
 }
 
+// Unlink the segment NAME while keeping the mapping (POSIX semantics:
+// pages live until the last munmap/process exit).  Called by every
+// local rank once the agreement round proves all of them have mapped —
+// from then on a SIGKILLed job cannot leak a /dev/shm file, the
+// failure mode plain destroy-time unlink leaves behind.  ENOENT from
+// the second-and-later callers is the desired end state.
+void hvd_ring_shm_unlink_name(void* h) {
+  auto* c = static_cast<RingComm*>(h);
+  if (!c->shm_name.empty()) {
+    ::shm_unlink(c->shm_name.c_str());
+    c->shm_name.clear();
+  }
+}
+
 // 1 when same-host hops ride shared memory (observability/tests).
 int hvd_ring_shm_active(void* h) {
   auto* c = static_cast<RingComm*>(h);
@@ -909,9 +924,8 @@ void hvd_ring_destroy(void* h) {
   if (c->listen_fd >= 0) ::close(c->listen_fd);
   if (c->shm_base != nullptr) {
     ::munmap(c->shm_base, c->shm_len);
-    // Every local rank unlinks; after the first the rest get ENOENT,
-    // which is the desired end state either way.
-    ::shm_unlink(c->shm_name.c_str());
+    if (!c->shm_name.empty())  // normally already unlinked post-agreement
+      ::shm_unlink(c->shm_name.c_str());
   }
   delete c;
 }

@@ -109,6 +109,7 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
         ctypes.POINTER(ctypes.c_int)]
     lib.hvd_ring_shm_enable.argtypes = [ctypes.c_void_p]
+    lib.hvd_ring_shm_unlink_name.argtypes = [ctypes.c_void_p]
     lib.hvd_ring_shm_active.restype = ctypes.c_int
     lib.hvd_ring_shm_active.argtypes = [ctypes.c_void_p]
     lib.hvd_ring_destroy.argtypes = [ctypes.c_void_p]
@@ -278,6 +279,12 @@ class RingBackend(Backend):
                     f"addrs={addrs}); all ranks use the XLA fallback")
             if shm_rc == 0 and all(o == "1:%d" % cap for o in oks):
                 lib.hvd_ring_shm_enable(self._comm)
+            if shm_rc == 0:
+                # The agreement round proves every local rank has
+                # mapped the segment: unlink the NAME now (mapping
+                # stays alive), so even a SIGKILLed job cannot leak a
+                # /dev/shm file.
+                lib.hvd_ring_shm_unlink_name(self._comm)
             self.stats["ring_shm"] = bool(
                 lib.hvd_ring_shm_active(self._comm))
         except Exception:

@@ -171,3 +171,42 @@ def test_formation_stall_attributed_and_failed():
             c.close()
     finally:
         srv.stop()
+
+
+def test_init_shutdown_churn_nproc3():
+    """Repeated shutdown+init cycles with collectives in between: each
+    incarnation re-forms the controller, ring (incl. the shm segment,
+    which must unlink and re-create cleanly), and response cache under
+    fresh incarnation-scoped namespaces.  Catches cross-incarnation
+    leakage the single-cycle reinit test cannot."""
+    results = run_workers("""
+import numpy as np
+import glob
+from horovod_tpu.common import basics
+
+pre_existing = set(glob.glob("/dev/shm/hvdring*"))
+for cycle in range(4):
+    if cycle:
+        hvd.init()
+    for step in range(3):
+        y = np.asarray(hvd.allreduce(
+            np.full(64, float(RANK + 1), np.float32), op=hvd.Sum,
+            name="c%d.s%d" % (cycle, step)))
+        np.testing.assert_allclose(y, sum(range(1, SIZE + 1)))
+    # Same op name EVERY cycle: a stale response cache or shm channel
+    # state crossing incarnations would corrupt or wedge this.
+    y = np.asarray(hvd.allreduce(np.full(8, 1.0, np.float32),
+                                 op=hvd.Sum, name="stable"))
+    np.testing.assert_allclose(y, SIZE)
+    hvd.barrier()
+    hvd.shutdown()
+# Only segments THIS test's incarnations created count: /dev/shm is
+# host-global and other jobs' files are not ours to assert about.
+leftover = set(glob.glob("/dev/shm/hvdring*")) - pre_existing
+print("CHURN OK rank=%d leftover=%d" % (RANK, len(leftover)))
+""", nproc=3, timeout=300)
+    assert_all_ok(results)
+    for _, out in results:
+        assert "CHURN OK" in out
+        # All incarnations' shm segments must be unlinked by shutdown.
+        assert "leftover=0" in out, out[-500:]
