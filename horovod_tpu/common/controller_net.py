@@ -111,29 +111,29 @@ class CoordinatorServer:
         self._seen = 0
         self._departed = 0
         self._departed_cond = threading.Condition()
-        # tensor name -> element count, for fusion byte accounting
-        self._elem_cache: Dict[str, int] = {}
-        # tensor name -> grouped-submission id (group-atomic fusion)
-        self._group_ids: Dict[str, int] = {}
+        # (psid, name) -> element count, for fusion byte accounting
+        self._elem_cache: Dict[tuple, int] = {}
+        # (psid, name) -> grouped-submission id (group-atomic fusion)
+        self._group_ids: Dict[tuple, int] = {}
         self._joined: Set[int] = set()
         self._last_joined = -1
-        # barrier name -> ranks arrived
-        self._barriers: Dict[str, Set[int]] = {}
+        # barrier (psid, name) -> ranks arrived
+        self._barriers: Dict[tuple, Set[int]] = {}
         # --- response-cache fast path (reference controller.cc:81-236) ---
         self._cache = CoordinatorCache(cache_capacity)
-        # tensor name -> True while every contribution this round came
+        # (psid, name) -> True while every contribution this round came
         # from a live cache bit (a full request degrades the round)
-        self._bit_only: Dict[str, bool] = {}
+        self._bit_only: Dict[tuple, bool] = {}
         self._pending_evictions: List[int] = []
         self.stats = {"full_rounds": 0, "fast_rounds": 0,
                       "fast_tensors": 0, "negotiated_tensors": 0}
         # --- coordinator-side stall attribution (reference
         #     stall_inspector.h:74-80: rank 0 names which ranks are
         #     missing a tensor) ---
-        self._first_seen: Dict[str, float] = {}
+        self._first_seen: Dict[tuple, float] = {}
         self._stall_warning_s = stall_warning_time_s
         self._stall_shutdown_s = stall_shutdown_time_s
-        self._stall_logged: Dict[str, float] = {}
+        self._stall_logged: Dict[tuple, float] = {}
         self._conns: Dict[int, socket.socket] = {}
         # Formation gate: NOTHING may be negotiated (and so no frame
         # broadcast) until every rank of this incarnation has
@@ -218,13 +218,7 @@ class CoordinatorServer:
                     self._formed = True
                     pre, self._pre_formed = self._pre_formed, []
                     for kind, r, payload in pre:
-                        if kind == "rq":
-                            self._process(
-                                r, [(req, False) for req in payload])
-                        else:
-                            items = self._resolve_hits(r, payload)
-                            if items:
-                                self._process(r, items)
+                        self._dispatch_uplink_locked(kind, r, payload)
             with self._departed_cond:
                 self._seen += 1
                 self._departed_cond.notify_all()
@@ -349,7 +343,7 @@ class CoordinatorServer:
             if not self._formed and not self._broken:
                 self._pre_formed.append(("rq", rank, requests))
                 return
-            self._process(rank, [(req, False) for req in requests])
+            self._dispatch_uplink_locked("rq", rank, requests)
 
     def _handle_cache_hits(self, rank: int, bits: List[int]):
         """Fast-path uplink: each bit is a full request the worker
@@ -362,9 +356,18 @@ class CoordinatorServer:
                 # for defense in depth.
                 self._pre_formed.append(("ch", rank, bits))
                 return
-            items = self._resolve_hits(rank, bits)
-            if items:
-                self._process(rank, items)
+            self._dispatch_uplink_locked("ch", rank, bits)
+
+    def _dispatch_uplink_locked(self, kind: str, rank: int, payload):
+        """Route one uplink frame ("rq" request list / "ch" bit list)
+        into _process; shared by the live path and the formation-gate
+        drain (caller holds self._lock)."""
+        if kind == "rq":
+            items = [(req, False) for req in payload]
+        else:
+            items = self._resolve_hits(rank, payload)
+        if items:
+            self._process(rank, items)
 
     def _resolve_hits(self, rank: int, bits: List[int]
                       ) -> List[Tuple[Request, bool]]:

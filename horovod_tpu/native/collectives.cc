@@ -151,7 +151,7 @@ struct Backoff {
     ::sched_yield();
     return true;
   }
-  void reset() { spins = 0; timing = false; }
+  void reset() { spins = 0; yields = 0; timing = false; }
 };
 
 // Push up to n bytes into the channel; advances p/n by what fit.

@@ -182,7 +182,6 @@ def test_init_shutdown_churn_nproc3():
     results = run_workers("""
 import numpy as np
 import glob
-from horovod_tpu.common import basics
 
 pre_existing = set(glob.glob("/dev/shm/hvdring*"))
 for cycle in range(4):
