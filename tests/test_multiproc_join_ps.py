@@ -139,3 +139,44 @@ def test_reducescatter_uneven_3proc():
         print("OK")
     """, nproc=3)
     assert_all_ok(results)
+
+
+def test_join_with_process_set_ops_nproc4():
+    """A joined (data-exhausted) rank must count toward completion of
+    SUBGROUP collectives it belongs to, with zero-substitution — the
+    reference's Join semantics extended to process sets
+    (controller.cc:254-308 zero rows for joined ranks).  Rank 3 joins
+    early; ps_odd=[1,3] ops must still complete for rank 1 with only
+    rank 3's zeros substituted."""
+    results = run_workers("""
+import numpy as np
+
+ps_odd = hvd.ProcessSet([1, 3])
+hvd.init(process_sets=[ps_odd])
+
+if RANK == 3:
+    last = hvd.join()     # out of data immediately
+else:
+    # World op: joined rank 3 is zero-substituted.
+    y = np.asarray(hvd.allreduce(
+        np.full(6, float(RANK + 1), np.float32), op=hvd.Sum,
+        name="w"))
+    np.testing.assert_allclose(y, 1.0 + 2.0 + 3.0)
+    if RANK == 1:
+        # Subgroup op on [1,3] with 3 joined: must complete with
+        # rank 3 contributing zeros, not hang on required=2.
+        z = np.asarray(hvd.allreduce(
+            np.full(4, 5.0, np.float32), op=hvd.Sum, name="ps",
+            process_set=ps_odd))
+        np.testing.assert_allclose(z, 5.0)
+    last = hvd.join()
+# join() reports the rank that joined LAST overall; rank 3's join is
+# provably registered before any other rank can join (the world op
+# needs its joined status to complete), so last must be a
+# data-bearing rank.
+assert last != 3 and 0 <= last < SIZE, last
+print("JOIN-PS OK rank=%d" % RANK)
+""", nproc=4, timeout=240)
+    assert_all_ok(results)
+    for _, out in results:
+        assert "JOIN-PS OK" in out

@@ -209,3 +209,4 @@ print("CHURN OK rank=%d leftover=%d" % (RANK, len(leftover)))
         assert "CHURN OK" in out
         # All incarnations' shm segments must be unlinked by shutdown.
         assert "leftover=0" in out, out[-500:]
+
