@@ -525,11 +525,13 @@ def _free_ports(n):
     return ports
 
 
-def bench_collectives(sizes_mb, nproc=2, timeout=600) -> dict:
+def bench_collectives(sizes_mb, nproc=2, timeout=600,
+                      plane=None) -> dict:
     """Spawn nproc CPU worker processes exercising hvd.allreduce through
-    the full eager path: TCP controller + cache fast path + fused XLA
-    data plane. gbps is per-rank effective throughput (payload bytes /
-    wall time)."""
+    the full eager path: TCP controller + cache fast path + the data
+    plane (default = native ring incl. same-host shm; plane="XLA"
+    forces the XLA mesh backend for a control lane). gbps is per-rank
+    effective throughput (payload bytes / wall time)."""
     repo = os.path.dirname(os.path.abspath(__file__))
     coord_port, ctrl_port = _free_ports(2)
     procs = []
@@ -546,6 +548,12 @@ def bench_collectives(sizes_mb, nproc=2, timeout=600) -> dict:
             "BENCH_SIZES_MB": json.dumps(sizes_mb),
             "PYTHONPATH": repo,
         })
+        # Scrub any ambient plane choice: the baseline lane must be
+        # the default (native ring) for the ring-vs-XLA comparison in
+        # the artifact to mean anything.
+        env.pop("HOROVOD_CPU_OPERATIONS", None)
+        if plane:
+            env["HOROVOD_CPU_OPERATIONS"] = plane
         env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _WORKER_SRC], env=env,
@@ -784,6 +792,22 @@ def main():
         sizes = [1] if args.smoke else [1, 4, 16, 64, 256]
         try:
             out["allreduce_eager"] = bench_collectives(sizes)
+            # XLA-mesh control lane at 1 MB: quantifies, in the same
+            # artifact, why the native ring (+shm) is the CPU default
+            # (per-call compiled-collective dispatch costs ms).
+            try:
+                xla = bench_collectives([1], plane="XLA")
+                out["allreduce_eager"]["xla_control_1mb"] = {
+                    "gbps": next((r["gbps"] for r in
+                                  xla.get("results", [])
+                                  if r["input"] == "numpy"), None),
+                    "tiny_allreduce_ms": xla.get(
+                        "control_floor", {}).get("tiny_allreduce_ms"),
+                    "error": xla.get("error"),
+                }
+            except Exception as e:
+                out["allreduce_eager"]["xla_control_1mb"] = {
+                    "error": repr(e)[:200]}
         except Exception as e:
             out["allreduce_eager"] = {"error": repr(e)[:300]}
 

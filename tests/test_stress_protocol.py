@@ -65,13 +65,15 @@ print("SOAK OK rank=%d" % RANK)
     assert_all_ok(results)
 
 
-def test_same_name_on_two_process_sets_concurrently():
+@pytest.mark.parametrize("plane", ["RING", "XLA"])
+def test_same_name_on_two_process_sets_concurrently(plane):
     """Regression: the SAME tensor name in flight on two disjoint
     process sets at once.  The reference supports this structurally
     (each process set owns its own controller); a name-only message
     table mixed the two negotiations and wedged both sets — all
     coordinator state is now keyed (process_set_id, name), Python and
-    C++ coordinators alike."""
+    C++ coordinators alike.  Parametrized over both eager data planes
+    (native ring incl. shm, XLA mesh)."""
     results = run_workers("""
 import numpy as np
 
@@ -95,7 +97,8 @@ for it in range(8):
     np.testing.assert_allclose(y, exp)
 hvd.barrier()
 print("OK rank=%d" % RANK)
-""", nproc=4, timeout=240)
+""", nproc=4, timeout=240,
+        extra_env={"HOROVOD_CPU_OPERATIONS": plane})
     assert_all_ok(results)
 
 
