@@ -80,6 +80,14 @@ class HorovodTpuState:
         self.rank_info = RankInfo()
         self.knobs = Knobs()
         self.process_sets: List[ProcessSet] = [global_process_set]
+        # Monotonic: ids are NEVER reused.  Deriving the next id from
+        # len(process_sets) would hand a removed set's id to a new set
+        # while another registered set still holds it — two live sets
+        # sharing an id collides every (psid, name)-keyed coordinator
+        # structure.  Advances identically on every rank because
+        # add/remove_process_set are collective calls (reference
+        # contract, process_set.h).
+        self.next_process_set_id = 1  # 0 = global
         self.backend = None          # ops data-plane backend
         self.runtime = None          # background negotiation runtime
         self.timeline = None
@@ -453,7 +461,16 @@ def stop_timeline():
 def add_process_set(ranks) -> ProcessSet:
     state = _state()
     ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
-    ps.process_set_id = len(state.process_sets)
+    if getattr(ps, "process_set_id", -1) is not None and \
+            ps.process_set_id >= 0:
+        # Double registration would duplicate the registry entry and
+        # desync it from the id sentinel; registered iff id >= 0.
+        raise ValueError(
+            "process set %r is already registered (id %d); call "
+            "remove_process_set first to re-register" %
+            (ps, ps.process_set_id))
+    ps.process_set_id = state.next_process_set_id
+    state.next_process_set_id += 1
     state.process_sets.append(ps)
     return ps
 
@@ -462,3 +479,6 @@ def remove_process_set(ps: ProcessSet):
     state = _state()
     if ps in state.process_sets and ps.process_set_id != 0:
         state.process_sets.remove(ps)
+        # Unregistered again: submit-time validation rejects it until
+        # re-added (which assigns a FRESH id — ids are never reused).
+        ps.process_set_id = -1

@@ -180,3 +180,42 @@ print("JOIN-PS OK rank=%d" % RANK)
     assert_all_ok(results)
     for _, out in results:
         assert "JOIN-PS OK" in out
+
+
+def test_process_set_ids_never_reused_after_remove():
+    """Regression: deriving ids from len(process_sets) hands a removed
+    set's id to the next add while another live set still holds it —
+    two live sets sharing an id collides every (psid, name)-keyed
+    coordinator structure.  Ids must be monotonic; a removed set must
+    be rejected at submit until re-added."""
+    results = run_workers("""
+import numpy as np
+
+a = hvd.add_process_set([0, 1])
+b = hvd.add_process_set([0, 1])
+assert (a.process_set_id, b.process_set_id) == (1, 2), \\
+    (a.process_set_id, b.process_set_id)
+hvd.remove_process_set(a)
+c = hvd.add_process_set([0, 1])
+assert c.process_set_id == 3, c.process_set_id        # never 2
+assert a.process_set_id == -1, a.process_set_id       # unregistered
+
+# The removed set is rejected, the live ones work — with the SAME
+# tensor name concurrently (the collision the monotonic ids prevent).
+try:
+    hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="x",
+                  process_set=a)
+    raise SystemExit("expected ValueError for removed set")
+except ValueError:
+    pass
+hb = hvd.allreduce_async(np.full(3, 1.0, np.float32), op=hvd.Sum,
+                         name="x", process_set=b)
+hc = hvd.allreduce_async(np.full(5, 2.0, np.float32), op=hvd.Sum,
+                         name="x", process_set=c)
+np.testing.assert_allclose(np.asarray(hvd.synchronize(hb)), 2.0)
+np.testing.assert_allclose(np.asarray(hvd.synchronize(hc)), 4.0)
+print("PSID OK rank=%d" % RANK)
+""", nproc=2, timeout=240)
+    assert_all_ok(results)
+    for _, out in results:
+        assert "PSID OK" in out
