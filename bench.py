@@ -494,9 +494,14 @@ barrier_floor = timed_floor(hvd.barrier)
 from horovod_tpu.common import basics
 stats = dict(basics._state().runtime.controller.stats)
 backend_stats = dict(getattr(basics._state().backend, "stats", {}))
+# Registry snapshot: records fusion efficiency, cache hit rate, and
+# the cycle/submit latency histograms in the BENCH artifact, so the
+# perf trajectory carries structure, not just wall time.
+metrics_snap = hvd.metrics_snapshot()
 if RANK == 0:
     print("BENCHJSON " + json.dumps({
         "results": results, "frames": stats,
+        "metrics": metrics_snap,
         "backend": {"type": type(basics._state().backend).__name__,
                     "ring_shm": backend_stats.get("ring_shm"),
                     "ring_allreduces":

@@ -18,10 +18,12 @@ from .version import __version__
 
 from .common.basics import (Adasum, Average, Max, Min, Product, Sum,
                             ProcessSet, add_process_set,
+                            cluster_metrics_snapshot,
                             cross_rank, cross_size, global_process_set,
                             gloo_built, gloo_enabled, init, is_homogeneous,
                             is_initialized, local_chips, local_rank,
-                            local_size, mpi_built, mpi_enabled,
+                            local_size, metrics_snapshot, mpi_built,
+                            mpi_enabled,
                             mpi_threads_supported, nccl_built, num_chips,
                             rank, remove_process_set, shutdown, size,
                             start_timeline, stop_timeline, cuda_built,
@@ -47,6 +49,7 @@ __all__ = [
     "gloo_built", "gloo_enabled", "nccl_built", "cuda_built", "rocm_built",
     "ccl_built", "xla_built", "xla_enabled",
     "start_timeline", "stop_timeline",
+    "metrics_snapshot", "cluster_metrics_snapshot",
     "ProcessSet", "global_process_set", "add_process_set",
     "remove_process_set",
     # ops & op constants

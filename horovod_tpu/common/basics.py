@@ -91,6 +91,7 @@ class HorovodTpuState:
         self.backend = None          # ops data-plane backend
         self.runtime = None          # background negotiation runtime
         self.timeline = None
+        self.metrics_server = None   # /metrics HTTP endpoint (opt-in)
         self.parameter_manager = None
         self.elastic_enabled = False
         self.host_messages = None    # elastic host-update queue
@@ -250,6 +251,29 @@ def init(comm=None, process_sets=None):
                 mark_cycles=state.knobs.timeline_mark_cycles)
             state.runtime.timeline = state.timeline
 
+        if state.knobs.metrics_port is not None and \
+                state.metrics_server is None:
+            from . import metrics as metrics_mod
+            # Per-local-rank offset: with several ranks on one host a
+            # fixed port would let only the first binder serve; 0
+            # still means "ephemeral" for every rank.
+            port = state.knobs.metrics_port
+            if port:
+                port += state.rank_info.local_rank
+            try:
+                state.metrics_server = metrics_mod.serve(
+                    port=port,
+                    cluster_provider=cluster_metrics_snapshot)
+                logger.info("metrics endpoint on port %d",
+                            state.metrics_server.port)
+            except (OSError, OverflowError, ValueError):
+                # Includes out-of-range ports (bind raises
+                # OverflowError, not OSError): a bad observability
+                # knob must never take down training.
+                logger.warning(
+                    "could not start the /metrics endpoint on port %d",
+                    port, exc_info=True)
+
         if process_sets:
             for ps in process_sets:
                 add_process_set(ps)
@@ -295,6 +319,9 @@ def shutdown():
         if state.timeline is not None:
             state.timeline.close()
             state.timeline = None
+        if state.metrics_server is not None:
+            state.metrics_server.stop()
+            state.metrics_server = None
         if state.backend is not None and hasattr(state.backend, "close"):
             state.backend.close()
         state.backend = None
@@ -432,6 +459,30 @@ def xla_built() -> bool:
 
 def xla_enabled() -> bool:
     return True
+
+
+def metrics_snapshot() -> dict:
+    """Plain-dict snapshot of this process's runtime metrics registry:
+    ``{"counters": {...}, "gauges": {...}, "histograms": {...}}``.
+    Labeled metrics map ``"k=v,..."`` child keys to values; histograms
+    carry count/sum/min/max plus fixed log-scale buckets.  Meaningful
+    before/after init (the registry is process-wide); see
+    docs/observability.md."""
+    from . import metrics as metrics_mod
+    return metrics_mod.snapshot()
+
+
+def cluster_metrics_snapshot():
+    """Merged cross-rank snapshot, available on the rank that hosts the
+    Python coordinator once HOROVOD_METRICS_AGG_SECONDS-driven polls
+    have collected per-rank snapshots; None anywhere else (workers,
+    native coordinator, aggregation disabled)."""
+    state = _state()
+    server = getattr(getattr(state.runtime, "controller", None),
+                     "server", None)
+    if server is None or not hasattr(server, "merged_metrics"):
+        return None
+    return server.merged_metrics()
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False):

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from . import metrics
 from .controller import Controller, MessageTable, construct_response
 from .fusion import fuse_responses
 from .message import (Request, RequestType, Response, ResponseType,
@@ -52,6 +53,29 @@ _MAGIC_CACHE = b"CB"    # coord→worker: fused batches of cache bits
 _MAGIC_EVICT = b"EV"    # coord→worker: evicted cache bits
 _MAGIC_PARAMS = b"PA"   # coord→worker: autotuned runtime parameters
 _MAGIC_ABORT = b"AB"    # coord→worker: membership broken, fail fast
+_MAGIC_METRICS_REQ = b"MQ"  # coord→worker: send a metrics snapshot
+_MAGIC_METRICS_REP = b"MR"  # worker→coord: metrics snapshot (JSON)
+
+_FRAMES_SENT = metrics.counter(
+    "hvd_frames_sent_total", "Control-plane frames sent, by kind")
+_FRAMES_RECV = metrics.counter(
+    "hvd_frames_recv_total", "Control-plane frames received, by kind")
+_BYTES_SENT = metrics.counter(
+    "hvd_bytes_sent_total", "Control-plane bytes sent (incl. headers)")
+_BYTES_RECV = metrics.counter(
+    "hvd_bytes_recv_total",
+    "Control-plane bytes received (incl. headers)")
+_INLINE = metrics.counter(
+    "hvd_inline_cache_total",
+    "Submitting-thread inline fast-path outcomes (hit = CH frame sent "
+    "without waking the background thread)")
+_ROUNDS = metrics.counter(
+    "hvd_negotiation_rounds_total",
+    "Coordinator broadcast rounds, by kind (fast = pure cache-bit CB "
+    "frame, full = negotiated RS frame)")
+_COORD_TENSORS = metrics.counter(
+    "hvd_negotiated_tensors_total",
+    "Tensors completed on the coordinator, by path")
 
 
 def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
@@ -90,7 +114,8 @@ class CoordinatorServer:
                  allow_ephemeral_fallback: bool = False,
                  param_manager=None, cache_capacity: int = 1024,
                  stall_warning_time_s: float = 60.0,
-                 stall_shutdown_time_s: float = 0.0):
+                 stall_shutdown_time_s: float = 0.0,
+                 metrics_interval_s: float = 0.0):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
@@ -177,6 +202,17 @@ class CoordinatorServer:
                 target=self._stall_loop, name="hvd-coord-stall",
                 daemon=True)
             self._stall_thread.start()
+        # --- cross-rank metrics aggregation (MQ/MR frames): collect
+        #     per-rank registry snapshots and expose the merged view,
+        #     the metrics analog of the rank-0 stall report ---
+        self._rank_metrics: Dict[int, dict] = {}
+        self._metrics_interval_s = metrics_interval_s
+        self._metrics_thread = None
+        if metrics_interval_s > 0:
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_loop, name="hvd-coord-metrics",
+                daemon=True)
+            self._metrics_thread.start()
 
     def _accept_loop(self):
         self._srv.settimeout(0.5)
@@ -238,8 +274,14 @@ class CoordinatorServer:
                 if frame is None:
                     return
                 magic, payload = frame
+                _FRAMES_RECV.inc(1, kind=magic.decode("ascii",
+                                                      "replace"))
+                _BYTES_RECV.inc(len(payload) + 6)
                 if magic == _MAGIC_HITS:
                     self._handle_cache_hits(rank, unpack_bits(payload))
+                    continue
+                if magic == _MAGIC_METRICS_REP:
+                    self._handle_metrics_snapshot(rank, payload)
                     continue
                 requests, shutdown = unpack_request_list(payload)
                 if shutdown:
@@ -258,6 +300,41 @@ class CoordinatorServer:
         with self._departed_cond:
             return self._seen, self._departed
 
+    # ------------------------------------------------------------------
+    # cross-rank metrics aggregation
+    # ------------------------------------------------------------------
+    def _metrics_loop(self):
+        while not self._stop.wait(self._metrics_interval_s):
+            self.request_metrics()
+
+    def request_metrics(self):
+        """Broadcast one MQ poll; every worker (including rank 0's
+        loopback client) answers with an MR snapshot frame."""
+        with self._lock:
+            self._broadcast_frame_locked(_MAGIC_METRICS_REQ, b"")
+
+    def _handle_metrics_snapshot(self, rank: int, payload: bytes):
+        try:
+            snap = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            logger.warning("undecodable metrics snapshot from rank %d",
+                           rank)
+            return
+        with self._lock:
+            self._rank_metrics[rank] = snap
+
+    def merged_metrics(self) -> Optional[dict]:
+        """Sum of the latest per-rank snapshots (None until the first
+        MR frame lands).  ``ranks`` names the contributors, so a
+        scraper can tell a partial merge from a full one."""
+        with self._lock:
+            snaps = dict(self._rank_metrics)
+        if not snaps:
+            return None
+        merged = metrics.merge_snapshots(snaps[r] for r in sorted(snaps))
+        merged["ranks"] = sorted(snaps)
+        return merged
+
     def _on_rank_lost(self, rank: int, clean: bool):
         """A rank departed mid-run.  In elastic mode, pending
         negotiations can never complete: fail them on every surviving
@@ -265,6 +342,12 @@ class CoordinatorServer:
         and unwind to the elastic retry loop (the analog of the
         reference's collective errors on peer failure,
         common/exceptions.py:18 semantics)."""
+        with self._lock:
+            # A departed rank must stop contributing to the merged
+            # metrics view: its frozen last snapshot would otherwise be
+            # summed into every future merge, and the ``ranks``
+            # contributor list would keep advertising a dead process.
+            self._rank_metrics.pop(rank, None)
         if not self.elastic:
             return
         with self._lock:
@@ -527,12 +610,14 @@ class CoordinatorServer:
                     self._group_ids.get(key, -1) not in full_groups:
                 hit_responses.append(ent[1])
                 self.stats["fast_tensors"] += 1
+                _COORD_TENSORS.inc(1, path="fast")
                 continue
             resp = construct_response(msgs[0].tensor_name, msgs,
                                       self.size, self._joined)
             sig_by_key[key] = request_signature(msgs[0])
             full_responses.append(resp)
             self.stats["negotiated_tensors"] += 1
+            _COORD_TENSORS.inc(1, path="negotiated")
             self._cache.clear_tombstones_for(key)
 
         nbytes = 0
@@ -546,6 +631,7 @@ class CoordinatorServer:
             payload = pack_bit_batches(batches)
             self._broadcast_frame_locked(_MAGIC_CACHE, payload)
             self.stats["fast_rounds"] += 1
+            _ROUNDS.inc(1, kind="fast")
             nbytes += sum(self._elem_cache.get((fr.process_set_id, n),
                                                0) *
                           dtype_size(fr.tensor_type)
@@ -558,6 +644,7 @@ class CoordinatorServer:
             self._flush_evictions_locked()
             self._broadcast_locked(fused)
             self.stats["full_rounds"] += 1
+            _ROUNDS.inc(1, kind="full")
             nbytes += sum(self._elem_cache.get((fr.process_set_id, n),
                                                0) *
                           dtype_size(fr.tensor_type)
@@ -634,6 +721,13 @@ class CoordinatorServer:
                 dead.append(r)
         for r in dead:
             self._conns.pop(r, None)
+        sent = len(self._conns)
+        if sent:
+            # Coordinator fan-out is the dominant control-plane send
+            # volume on rank 0 — account it next to the worker-side
+            # counters (same registry, same process).
+            _FRAMES_SENT.inc(sent, kind=magic.decode("ascii", "replace"))
+            _BYTES_SENT.inc(sent * (len(payload) + 6))
 
     # ------------------------------------------------------------------
     # stall attribution (reference stall_inspector.{h,cc}: rank-0 names
@@ -763,10 +857,14 @@ class NetworkController(Controller):
         self._seed_log = deque(maxlen=64)
         self.stats = {"rq_frames": 0, "ch_frames": 0, "rs_frames": 0,
                       "cb_frames": 0, "ev_frames": 0, "pa_frames": 0,
+                      "mr_frames": 0,
                       "bytes_sent": 0, "bytes_recv": 0}
         # PA params stashed until the batches received before them have
         # executed (applied at the next compute_response_list entry).
         self._pending_params: Optional[dict] = None
+        # True while an MR (metrics snapshot) reply thread is in
+        # flight; written only by the recv thread.
+        self._mr_sending = False
         addr = os.environ.get(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
@@ -834,7 +932,9 @@ class NetworkController(Controller):
         """Prefer the native C++ coordinator (horovod_tpu/native); fall
         back to the Python CoordinatorServer.  The Python server is
         also used when a timeline is active (negotiation spans are
-        recorded coordinator-side) and while the autotuner runs (the
+        recorded coordinator-side), when cross-rank metrics
+        aggregation is requested (MQ/MR frames), and while the
+        autotuner runs (the
         parameter manager scores real per-round byte counts in-line and
         announces categorical knobs via PA frames — higher-fidelity
         than the native counter-polling path it replaces)."""
@@ -854,7 +954,15 @@ class NetworkController(Controller):
                 "HOROVOD_AUTOTUNE=1: the autotuner requires the Python "
                 "coordinator (in-line scoring + PA parameter frames). "
                 "Unset one of the two.")
-        if state.timeline is None and param_manager is None:
+        metrics_interval = state.knobs.metrics_agg_interval_s
+        if strict_native and metrics_interval > 0:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_METRICS_AGG_SECONDS>0: cross-rank metrics "
+                "aggregation requires the Python coordinator (MQ/MR "
+                "frames).  Unset one of the two.")
+        if state.timeline is None and param_manager is None and \
+                metrics_interval <= 0:
             try:
                 from ..native import NativeCoordinatorServer, available
                 if strict_native and not available():
@@ -888,7 +996,8 @@ class NetworkController(Controller):
             param_manager=param_manager,
             cache_capacity=state.knobs.cache_capacity,
             stall_warning_time_s=stall_warn,
-            stall_shutdown_time_s=state.knobs.stall_shutdown_time_s)
+            stall_shutdown_time_s=state.knobs.stall_shutdown_time_s,
+            metrics_interval_s=metrics_interval)
 
     @staticmethod
     def _rendezvous_client():
@@ -985,6 +1094,11 @@ class NetworkController(Controller):
                 return
             magic, payload = frame
             self.stats["bytes_recv"] += len(payload) + 6
+            _BYTES_RECV.inc(len(payload) + 6)
+            _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
+            if magic == _MAGIC_METRICS_REQ:
+                self._spawn_metrics_reply()
+                continue
             if magic == _MAGIC_CACHE:
                 self.stats["cb_frames"] += 1
                 responses = self._reconstruct_cached(
@@ -1026,6 +1140,58 @@ class NetworkController(Controller):
             responses, _ = unpack_response_list(payload)
             self._seed_cache(responses)
             self._deliver(responses)
+
+    def _send_frame_counted_locked(self, magic: bytes, payload: bytes,
+                                   stat_key: str, kind: str):
+        """One uplink frame + its stats-dict and registry accounting in
+        lockstep (caller holds self._send_lock) — the single place the
+        frame-header byte math lives on the send side."""
+        _send_frame(self._sock, magic, payload)
+        self.stats[stat_key] = self.stats.get(stat_key, 0) + 1
+        self.stats["bytes_sent"] += len(payload) + 6
+        _FRAMES_SENT.inc(1, kind=kind)
+        _BYTES_SENT.inc(len(payload) + 6)
+
+    def _spawn_metrics_reply(self):
+        """MR replies ride their own short-lived thread: the recv
+        thread must NEVER block on _send_lock — a recv thread waiting
+        on a send while both TCP buffers are full closes a distributed
+        deadlock cycle with the coordinator's broadcast lock (coord
+        holds its lock writing to us, our submit thread holds
+        _send_lock writing to the coord, the coord's rank loop waits
+        on its lock, we'd wait here).  At most one reply in flight; a
+        poll arriving while the previous reply is still blocked is
+        dropped — snapshots are absolute, the next poll re-covers it.
+        The flag is advisory (set here, cleared by the reply thread):
+        the worst race outcome is one dropped poll."""
+        if self._mr_sending:
+            return
+        self._mr_sending = True
+
+        def run():
+            try:
+                self._send_metrics_snapshot()
+            finally:
+                self._mr_sending = False
+
+        threading.Thread(target=run, name="hvd-metrics-reply",
+                         daemon=True).start()
+
+    def _send_metrics_snapshot(self):
+        """MQ poll answer: ship this process's registry snapshot to
+        the coordinator."""
+        try:
+            payload = json.dumps(metrics.snapshot()).encode()
+        except (TypeError, ValueError):
+            logger.warning("metrics snapshot not serializable",
+                           exc_info=True)
+            return
+        try:
+            with self._send_lock:
+                self._send_frame_counted_locked(
+                    _MAGIC_METRICS_REP, payload, "mr_frames", "MR")
+        except OSError:
+            pass  # connection teardown races the poll; never fatal
 
     def _deliver(self, responses: List[Response]):
         if self._on_response is not None:
@@ -1099,15 +1265,17 @@ class NetworkController(Controller):
             raise self._broken_err
         if not self.cache.enabled:
             return False
-        bit = self.cache.lookup_bit(request)
+        # count_miss=False: a missed request falls back to the cycle,
+        # whose own lookup counts the same logical miss.
+        bit = self.cache.lookup_bit(request, count_miss=False)
         if bit is None:
+            _INLINE.inc(1, result="miss")
             return False
+        _INLINE.inc(1, result="hit")
         try:
             with self._send_lock:
-                payload = pack_bits([bit])
-                _send_frame(self._sock, _MAGIC_HITS, payload)
-                self.stats["ch_frames"] += 1
-                self.stats["bytes_sent"] += len(payload) + 6
+                self._send_frame_counted_locked(
+                    _MAGIC_HITS, pack_bits([bit]), "ch_frames", "CH")
         except OSError as e:
             from .exceptions import HorovodInternalError
             raise HorovodInternalError(
@@ -1145,15 +1313,13 @@ class NetworkController(Controller):
             try:
                 with self._send_lock:
                     if hit_bits:
-                        payload = pack_bits(hit_bits)
-                        _send_frame(self._sock, _MAGIC_HITS, payload)
-                        self.stats["ch_frames"] += 1
-                        self.stats["bytes_sent"] += len(payload) + 6
+                        self._send_frame_counted_locked(
+                            _MAGIC_HITS, pack_bits(hit_bits),
+                            "ch_frames", "CH")
                     if full:
-                        payload = pack_request_list(full)
-                        _send_frame(self._sock, _MAGIC_REQ, payload)
-                        self.stats["rq_frames"] += 1
-                        self.stats["bytes_sent"] += len(payload) + 6
+                        self._send_frame_counted_locked(
+                            _MAGIC_REQ, pack_request_list(full),
+                            "rq_frames", "RQ")
             except OSError as e:
                 from .exceptions import HorovodInternalError
                 raise HorovodInternalError(

@@ -45,6 +45,15 @@ HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOI
 
 # --- observability --------------------------------------------------------
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+# Opt-in Prometheus-text /metrics endpoint: set to a port (0 = pick an
+# ephemeral one); unset = no endpoint.  Each rank binds
+# port + local_rank so one knob serves multi-rank hosts.
+HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
+# Cross-rank metrics aggregation cadence (seconds): the rank-0
+# coordinator polls per-rank snapshots over the control plane at this
+# interval.  0 (default) = disabled; setting it opts into the Python
+# coordinator (the native one has no metrics frames).
+HOROVOD_METRICS_AGG_SECONDS = "HOROVOD_METRICS_AGG_SECONDS"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
@@ -86,6 +95,15 @@ def env_int(name: str, default: int) -> int:
         return int(v) if v not in (None, "") else default
     except ValueError:
         return default
+
+
+def env_int_opt(name: str):
+    """Optional int knob: None when unset/empty/unparseable."""
+    v = os.environ.get(name)
+    try:
+        return int(v) if v not in (None, "") else None
+    except ValueError:
+        return None
 
 
 def env_float(name: str, default: float) -> float:
@@ -151,6 +169,8 @@ class Knobs:
     autotune_gaussian_process_noise: float = 0.8
     timeline: Optional[str] = None
     timeline_mark_cycles: bool = False
+    metrics_port: Optional[int] = None
+    metrics_agg_interval_s: float = 0.0
     stall_check_disable: bool = False
     stall_warning_time_s: float = 60.0
     stall_shutdown_time_s: float = 0.0
@@ -177,6 +197,9 @@ class Knobs:
                 HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8),
             timeline=os.environ.get(HOROVOD_TIMELINE),
             timeline_mark_cycles=env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            metrics_port=env_int_opt(HOROVOD_METRICS_PORT),
+            metrics_agg_interval_s=env_float(
+                HOROVOD_METRICS_AGG_SECONDS, 0.0),
             stall_check_disable=env_bool(HOROVOD_STALL_CHECK_DISABLE),
             stall_warning_time_s=env_float(
                 HOROVOD_STALL_CHECK_TIME_SECONDS, 60.0),

@@ -15,11 +15,21 @@ the reference (SURVEY §7 hard parts).
 
 from typing import List
 
+from . import metrics
 from .message import Response, ResponseType, dtype_size
 
 
 _FUSABLE = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
             ResponseType.ALLGATHER, ResponseType.REDUCESCATTER}
+
+_FUSED_TENSORS = metrics.histogram(
+    "hvd_fusion_tensors_per_response",
+    "Tensors batched into one fused response",
+    bounds=metrics.COUNT_BUCKETS)
+_FUSED_BYTES = metrics.histogram(
+    "hvd_fusion_bytes",
+    "Payload bytes per fused response (vs. HOROVOD_FUSION_THRESHOLD)",
+    bounds=metrics.BYTE_BUCKETS)
 
 
 def response_bytes(resp: Response, entry_sizes) -> int:
@@ -131,4 +141,11 @@ def fuse_responses(responses: List[Response], entry_sizes,
                 # the scan; keep looking for fusable candidates behind it.
                 i += 1
         out.append(fused)
+    for resp in out:
+        if resp.response_type in _FUSABLE and resp.tensor_names:
+            _FUSED_TENSORS.observe(len(resp.tensor_names))
+            try:
+                _FUSED_BYTES.observe(response_bytes(resp, entry_sizes))
+            except KeyError:
+                pass  # caller passed a partial size map; skip bytes
     return out

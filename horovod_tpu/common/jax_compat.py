@@ -1,0 +1,28 @@
+"""JAX version-compat shims for the compiled data plane.
+
+The collective backends target the modern ``jax.shard_map`` entry
+point (with its ``check_vma`` kwarg); older installs only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
+``check_rep``.  One shim keeps every compiled-collective call site
+identical across versions instead of scattering try/except per site.
+"""
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` on new JAX, ``jax.experimental.shard_map`` on
+    old.  ``check_vma=None`` keeps the running version's own default;
+    an explicit bool maps onto whichever replication-check kwarg the
+    version spells (vma/rep)."""
+    import jax
+
+    kwargs = {}
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -40,7 +40,13 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from . import metrics
 from .message import Request, RequestType, Response, ResponseType
+
+_CACHE_EVENTS = metrics.counter(
+    "hvd_response_cache_total",
+    "Worker response-cache events (hit / miss / invalidate / evict); "
+    "a hit also implies a compiled-executable reuse on TPU")
 
 # Response types that participate in the cache (JOIN/BARRIER/ERROR are
 # control-flow, never cached — reference response_cache.cc caches the
@@ -166,22 +172,35 @@ class WorkerResponseCache:
     def enabled(self) -> bool:
         return self.capacity > 0
 
-    def lookup_bit(self, req: Request) -> Optional[int]:
+    def lookup_bit(self, req: Request,
+                   count_miss: bool = True) -> Optional[int]:
         """Bit for a HIT, else None.  A signature mismatch (INVALID)
         drops the local entry so the full request goes out and the
         coordinator renegotiates.  Entries are keyed by
         (process_set_id, name) — the same name may be cached for two
-        process sets at once."""
+        process sets at once.
+
+        ``count_miss=False`` suppresses the miss metric only: the
+        inline fast-path probe passes it because a missed request
+        falls back to the negotiation queue, where the cycle's own
+        lookup counts the SAME logical miss — counting both would
+        inflate misses ~2x.  Hits/invalidations happen exactly once
+        (a hit short-circuits the second lookup; an invalidation
+        deletes the entry) so they always count."""
         key = (req.process_set_id, req.tensor_name)
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
+                if count_miss:
+                    _CACHE_EVENTS.inc(1, event="miss")
                 return None
             bit, _, sig = ent
             if sig is None or sig != request_signature(req):
                 del self._entries[key]
                 self._bit_names.pop(bit, None)
+                _CACHE_EVENTS.inc(1, event="invalidate")
                 return None
+            _CACHE_EVENTS.inc(1, event="hit")
             return bit
 
     def insert(self, name: str, bit: int, response: Response,
@@ -206,6 +225,7 @@ class WorkerResponseCache:
                 name = self._bit_names.pop(b, None)
                 if name is not None:
                     self._entries.pop(name, None)
+                    _CACHE_EVENTS.inc(1, event="evict")
 
     def debug_bits(self):
         """bit -> key snapshot for desync diagnostics."""

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import metrics
 from . import timeline as tl
 from .controller import LoopbackController
 from .message import (Request, RequestType, Response, ResponseType)
@@ -29,6 +30,36 @@ from .stall_inspector import StallInspector
 from .tensor_queue import TensorQueue, TensorTableEntry
 
 logger = logging.getLogger("horovod_tpu.runtime")
+
+_CYCLES = metrics.counter(
+    "hvd_cycles_total", "Background cycle-loop iterations")
+_CYCLE_SECONDS = metrics.histogram(
+    "hvd_cycle_seconds",
+    "Work-cycle duration (queue drain through response dispatch)")
+_QUEUE_DEPTH = metrics.gauge(
+    "hvd_queue_depth", "Tensor-table entries awaiting completion")
+_SUBMIT_LATENCY = metrics.histogram(
+    "hvd_submit_latency_seconds",
+    "submit() to completion-callback latency per tensor")
+_RESPONSES = metrics.counter(
+    "hvd_responses_dispatched_total",
+    "Responses executed on this rank, by collective type")
+_JOIN_ZEROS = metrics.counter(
+    "hvd_join_zero_substituted_total",
+    "Zero tensors substituted for collectives this joined rank "
+    "did not submit")
+
+
+def _latency_wrapped(cb):
+    """Stamp submit time into the completion callback so the
+    submit-to-callback latency histogram sees every path (negotiated,
+    inline cache hit, error flush)."""
+    t0 = time.perf_counter()
+
+    def wrapped(ok, result):
+        _SUBMIT_LATENCY.observe(time.perf_counter() - t0)
+        return cb(ok, result)
+    return wrapped
 
 
 class BackgroundRuntime:
@@ -102,6 +133,7 @@ class BackgroundRuntime:
     def submit(self, request: Request, entry: TensorTableEntry):
         if self._error is not None:
             raise self._error
+        entry.callback = _latency_wrapped(entry.callback)
         nelem = 1
         for d in request.tensor_shape:
             nelem *= d
@@ -153,6 +185,8 @@ class BackgroundRuntime:
         if self._error is not None:
             raise self._error
         group_id = next(self._group_counter)
+        for entry in entries:
+            entry.callback = _latency_wrapped(entry.callback)
         for request in requests:
             request.group_id = group_id
             nelem = 1
@@ -160,6 +194,12 @@ class BackgroundRuntime:
                 nelem *= d
             self._entry_sizes[(request.process_set_id,
                                request.tensor_name)] = nelem
+            if self.timeline:
+                # Grouped tensors get the same negotiation span as
+                # single submissions — dispatch closes one span per
+                # tensor name, so every name must open one here.
+                self.timeline.negotiate_start(
+                    request.tensor_name, request.request_type.name)
         self.tensor_queue.add_multi(requests, entries)
         self._wake.set()
 
@@ -268,11 +308,18 @@ class BackgroundRuntime:
                 self._on_fatal(e)
 
     def _run_once(self):
+        _CYCLES.inc()
         if self.timeline:
             self.timeline.mark_cycle_start()
+        t0 = time.perf_counter()
         pending = self.tensor_queue.pop_pending()
+        _QUEUE_DEPTH.set(self.tensor_queue.outstanding())
         if not pending and self.state.rank_info.size == 1:
             return
+        if self.timeline and pending:
+            self.timeline.counter("queue_depth", {
+                "pending": len(pending),
+                "outstanding": self.tensor_queue.outstanding()})
         responses, leftovers = self.controller.compute_response_list(
             pending, self._entry_sizes,
             self.state.knobs.fusion_threshold_bytes)
@@ -291,6 +338,8 @@ class BackgroundRuntime:
             self.stall_inspector.check()
         for resp in responses:
             self._perform_operation(resp)
+        if pending or responses:
+            _CYCLE_SECONDS.observe(time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     # execution (PerformOperation analog)
@@ -303,6 +352,7 @@ class BackgroundRuntime:
             # coordinator broadcasts to everyone, non-members simply
             # don't participate in the sub-mesh program.
             return
+        _RESPONSES.inc(1, op=resp.response_type.name)
         entries: List[TensorTableEntry] = []
         for i, name in enumerate(resp.tensor_names):
             e = self.tensor_queue.pop_entry(name, resp.process_set_id)
@@ -322,6 +372,7 @@ class BackgroundRuntime:
                 e = TensorTableEntry(tensor_name=name, tensor=zero,
                                      callback=lambda ok, r: None,
                                      process_set_id=resp.process_set_id)
+                _JOIN_ZEROS.inc()
             if e is not None:
                 entries.append(e)
             if self.stall_inspector is not None:
@@ -348,6 +399,9 @@ class BackgroundRuntime:
         names = [e.tensor_name for e in entries]
         tl_name = names[0]
         ps_ranks = tuple(resp.process_set_ranks)
+        if self.timeline:
+            self.timeline.counter("fused_bytes", {"bytes": int(sum(
+                getattr(e.tensor, "nbytes", 0) for e in entries))})
         try:
             if self.timeline:
                 self.timeline.start_activity(

@@ -11,7 +11,13 @@ import logging
 import time
 from typing import Dict, List, Set, Tuple
 
+from . import metrics
+
 logger = logging.getLogger("horovod_tpu.stall")
+
+_STALL_WARNINGS = metrics.counter(
+    "hvd_stall_warnings_total",
+    "Tensors that crossed the stall warning threshold")
 
 
 class StallInspector:
@@ -55,6 +61,7 @@ class StallInspector:
                     f"waiting: {missing}]")
                 self._warned.add(name)
                 invalidate.append(name)
+                _STALL_WARNINGS.inc()
             if self.shutdown_time_s > 0 and age > self.shutdown_time_s:
                 raise RuntimeError(
                     f"Stalled tensor {name!r} exceeded shutdown threshold "

@@ -15,11 +15,14 @@ timeline there, matching the GPU event-queue split in the reference.
 """
 
 import json
+import logging
 import os
 import queue
 import threading
 import time
 from typing import Dict, Optional
+
+logger = logging.getLogger("horovod_tpu.timeline")
 
 # Activity names, matching the reference span vocabulary (common.h:32-62).
 NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
@@ -54,21 +57,30 @@ class TimelineWriter:
             self._queue.put(record)
 
     def _run(self):
-        os.makedirs(os.path.dirname(os.path.abspath(self._file_path)),
-                    exist_ok=True)
-        with open(self._file_path, "w") as f:
-            f.write("[\n")
-            first = True
-            while True:
-                rec = self._queue.get()
-                if rec is None:
-                    break
-                if not first:
-                    f.write(",\n")
-                f.write(json.dumps(rec))
-                first = False
-                f.flush()
-            f.write("\n]\n")
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(self._file_path)),
+                        exist_ok=True)
+            with open(self._file_path, "w") as f:
+                f.write("[\n")
+                first = True
+                while True:
+                    rec = self._queue.get()
+                    if rec is None:
+                        break
+                    if not first:
+                        f.write(",\n")
+                    f.write(json.dumps(rec))
+                    first = False
+                    f.flush()
+                f.write("\n]\n")
+        except Exception:
+            # Without this flip a writer that cannot open (or keep
+            # writing) its file dies silently while enqueue() keeps
+            # growing the queue unbounded for the rest of the run.
+            self._active = False
+            logger.warning(
+                "timeline writer failed for %s; timeline recording "
+                "disabled", self._file_path, exc_info=True)
 
     def close(self):
         if self._active:
@@ -124,6 +136,16 @@ class Timeline:
 
     def end_activity(self, tensor_name: str):
         self._emit_end(tensor_name)
+
+    def counter(self, name: str, values: Dict[str, float]):
+        """Chrome-tracing counter event ("ph":"C"): renders as a
+        stacked-area track alongside the spans, so live registry values
+        (queue depth, fused bytes) line up with negotiation/execution
+        activity in the same trace."""
+        if self.writer:
+            self.writer.enqueue({
+                "name": name, "ph": "C", "pid": 0, "tid": 0,
+                "ts": self._ts_us(), "args": dict(values)})
 
     def mark_cycle_start(self):
         if self.writer and self.mark_cycles:

@@ -18,10 +18,12 @@ import ctypes
 import logging
 import os
 import threading
+import time
 from typing import List
 
 import numpy as np
 
+from ..common import metrics
 from .backend import Backend, even_row_counts
 
 logger = logging.getLogger("horovod_tpu.ring")
@@ -452,11 +454,18 @@ class RingBackend(Backend):
     # -- allreduce -------------------------------------------------------
     def allreduce(self, arrays, reduce_op, prescale, postscale,
                   ps_ranks=()):
+        # Metrics are recorded only on native-ring completions: the
+        # fallback paths delegate to the (already instrumented) XLA
+        # backend, which would otherwise double-count.
+        t0 = time.perf_counter()
         if len(arrays) == 1 and not ps_ranks and reduce_op in _OPS:
             fast = self._allreduce_single_fast(
                 arrays[0], reduce_op, prescale, postscale)
             if fast is not None:
                 self.stats["ring_allreduces"] += 1
+                metrics.record_collective(
+                    "ring", "ALLREDUCE", metrics.list_nbytes(arrays),
+                    time.perf_counter() - t0)
                 return fast
         dt = np.result_type(*(np.asarray(a).dtype for a in arrays)) \
             if arrays else np.float32
@@ -511,6 +520,9 @@ class RingBackend(Backend):
                           casting="unsafe")
                 off += a.size
                 out.append(self._rewrap(piece, wj))
+        metrics.record_collective("ring", "ALLREDUCE",
+                                  metrics.list_nbytes(nps),
+                                  time.perf_counter() - t0)
         return out
 
     @staticmethod
@@ -542,6 +554,7 @@ class RingBackend(Backend):
                                               postscale, ps_ranks)
 
     # -- allgather -------------------------------------------------------
+    @metrics.timed_collective("ring", "ALLGATHER", metrics.list_nbytes)
     def allgather(self, arrays, sizes, ps_ranks=()):
         ranks_arr, nranks, gsize = self._group_args(tuple(ps_ranks))
         per_tensor_sizes = [sizes[i * gsize:(i + 1) * gsize]
@@ -569,6 +582,7 @@ class RingBackend(Backend):
         return out
 
     # -- broadcast -------------------------------------------------------
+    @metrics.timed_collective("ring", "BROADCAST", metrics.list_nbytes)
     def broadcast(self, arrays, root_rank, ps_ranks=()):
         ranks_arr, nranks, _ = self._group_args(tuple(ps_ranks))
         root = list(ps_ranks).index(root_rank) if ps_ranks else root_rank
@@ -591,6 +605,7 @@ class RingBackend(Backend):
     def _my_index(self, ps_ranks) -> int:
         return ps_ranks.index(self.rank) if ps_ranks else self.rank
 
+    @metrics.timed_collective("ring", "ALLTOALL", metrics.one_nbytes)
     def alltoall(self, array, splits, ps_ranks=(), split_matrix=None):
         """Pairwise-exchange alltoall over the native mesh, matching the
         XLA backend's semantics (splits = dim-0 row counts per
@@ -687,6 +702,10 @@ class RingBackend(Backend):
                 continue
             groups.setdefault(work_dt.str, []).append(
                 (i, a, self._is_jax(x)))
+        # Timer starts AFTER the classification loop: the per-tensor
+        # XLA fallbacks above already record their own wall time under
+        # backend="xla" — only native-ring work belongs to this record.
+        t0 = time.perf_counter()
         for dt_str, items in groups.items():
             work_dt = np.dtype(dt_str)
             rowcounts = [even_row_counts(a.shape[0], gsize)
@@ -733,6 +752,12 @@ class RingBackend(Backend):
                 out[i] = self._rewrap(piece, wj)
             self.stats["ring_reducescatters"] = \
                 self.stats.get("ring_reducescatters", 0) + len(items)
+        if groups:
+            metrics.record_collective(
+                "ring", "REDUCESCATTER",
+                sum(int(a.nbytes) for items in groups.values()
+                    for _, a, _ in items),
+                time.perf_counter() - t0)
         return out
 
     def barrier(self, ps_ranks=()):

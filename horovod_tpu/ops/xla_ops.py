@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common import metrics
+from ..common.jax_compat import shard_map
 from .backend import Backend, even_row_counts
 
 logger = logging.getLogger("horovod_tpu.xla_ops")
@@ -193,11 +195,12 @@ class XlaMeshBackend(Backend):
                 out.append(y)
             return tuple(out)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("world") for _ in range(n)),
             out_specs=tuple(P() for _ in range(n)), check_vma=False))
 
+    @metrics.timed_collective("xla", "ALLREDUCE", metrics.list_nbytes)
     def allreduce(self, arrays, reduce_op, prescale, postscale,
                   ps_ranks=()):
         if self.hierarchical_active(ps_ranks) and \
@@ -246,7 +249,7 @@ class XlaMeshBackend(Backend):
                 out.append(y)
             return tuple(out)
         n = len(shapes)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("cross", "local") for _ in range(n)),
             out_specs=tuple(P() for _ in range(n)), check_vma=False))
@@ -274,7 +277,7 @@ class XlaMeshBackend(Backend):
                 out.append(y)
             return tuple(out)
         n = len(shapes)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("cross", "local") for _ in range(n)),
             out_specs=tuple(P() for _ in range(n)), check_vma=False))
@@ -330,6 +333,7 @@ class XlaMeshBackend(Backend):
             results.append(r if was_jax else np.asarray(r))
         return results
 
+    @metrics.timed_collective("xla", "ADASUM", metrics.list_nbytes)
     def adasum_allreduce(self, arrays, prescale, postscale, ps_ranks=()):
         from .adasum import adasum_allreduce_global
         mesh, gsize, _ = self._group(tuple(ps_ranks))
@@ -357,11 +361,12 @@ class XlaMeshBackend(Backend):
                 out.append(jnp.concatenate(pieces, axis=0))
             return tuple(out)
         n = len(tsizes_per_tensor)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("world") for _ in range(n)),
             out_specs=tuple(P() for _ in range(n)), check_vma=False))
 
+    @metrics.timed_collective("xla", "ALLGATHER", metrics.list_nbytes)
     def allgather(self, arrays, sizes, ps_ranks=()):
         """``sizes`` holds ``group_size`` entries per tensor, in tensor
         order (fused responses concatenate them)."""
@@ -403,11 +408,12 @@ class XlaMeshBackend(Backend):
                 masked = jnp.where(idx == root, x, jnp.zeros_like(x))
                 out.append(jax.lax.psum(masked, "world"))
             return tuple(out)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("world") for _ in range(n)),
             out_specs=tuple(P() for _ in range(n)), check_vma=False))
 
+    @metrics.timed_collective("xla", "BROADCAST", metrics.list_nbytes)
     def broadcast(self, arrays, root_rank, ps_ranks=()):
         mesh, gsize, _ = self._group(tuple(ps_ranks))
         root = list(ps_ranks).index(root_rank) if ps_ranks else root_rank
@@ -431,7 +437,7 @@ class XlaMeshBackend(Backend):
             y = jax.lax.all_to_all(x[0], "world", split_axis=0,
                                    concat_axis=0, tiled=True)
             return y[None]
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=P("world"), out_specs=P("world"),
             check_vma=False))
 
@@ -475,6 +481,7 @@ class XlaMeshBackend(Backend):
             return jnp.concatenate(pieces, axis=0)
         return unpack
 
+    @metrics.timed_collective("xla", "ALLTOALL", metrics.one_nbytes)
     def alltoall(self, array, splits, ps_ranks=(), split_matrix=None):
         mesh, gsize, my_idx = self._group(tuple(ps_ranks))
         was_jax = isinstance(array, jax.Array)
@@ -533,7 +540,7 @@ class XlaMeshBackend(Backend):
                         x, "world", scatter_dimension=0, tiled=True)
                 out.append(y[None])
             return tuple(out)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("world") for _ in range(n)),
             out_specs=tuple(P("world") for _ in range(n)),
@@ -563,6 +570,7 @@ class XlaMeshBackend(Backend):
             return padded.reshape((gsize * chunk,) + arr.shape[1:])
         return pack
 
+    @metrics.timed_collective("xla", "REDUCESCATTER", metrics.list_nbytes)
     def reducescatter(self, arrays, reduce_op, ps_ranks=()):
         """Rank r receives its dim-0 shard of the sum; first ranks absorb
         the remainder (uneven-split convention matching allgather)."""

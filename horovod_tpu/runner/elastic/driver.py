@@ -15,12 +15,14 @@ TPU-native deltas:
     generation at ``state.commit()`` instead of a per-worker push RPC.
 """
 
+import json
 import logging
 import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import metrics
 from ..hosts import (HostInfo, INVALID_SLOT_INFO, SlotInfo,
                      get_host_assignments)
 from .discovery import HostDiscovery, HostManager
@@ -28,11 +30,25 @@ from .registration import WorkerStateRegistry
 
 logger = logging.getLogger("horovod_tpu.elastic")
 
+_EPOCHS = metrics.counter(
+    "hvd_elastic_epochs_total",
+    "Elastic epochs planned (initial formation + every resize)")
+_WORKER_FAILURES = metrics.counter(
+    "hvd_elastic_worker_failures_total",
+    "In-plan worker processes that exited non-zero")
+_WORLD_SIZE = metrics.gauge(
+    "hvd_elastic_world_size", "World size of the current elastic epoch")
+
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 
 # KV scopes/keys the driver publishes (worker side reads these).
 ELASTIC_SCOPE = "elastic"
 KEY_GENERATION = "generation"     # bumped on every discovery change
+# Driver-process metrics snapshot, readable through the (job-secret
+# guarded) rendezvous HTTP server at GET /metrics/driver — the driver
+# has no worker /metrics endpoint, so the KV store is its read path.
+METRICS_SCOPE = "metrics"
+KEY_DRIVER_METRICS = "driver"
 
 
 
@@ -210,6 +226,8 @@ class ElasticDriver:
                                      self._max_np)
         self._epoch += 1
         self._world_size = slots[0].size if slots else 0
+        _EPOCHS.inc()
+        _WORLD_SIZE.set(self._world_size)
         assignments: Dict[str, List[SlotInfo]] = OrderedDict()
         for s in slots:
             assignments.setdefault(s.hostname, []).append(s)
@@ -239,6 +257,7 @@ class ElasticDriver:
             self._rendezvous.init(self._host_assignments)
         logger.info("elastic: epoch %d planned, size=%d hosts=%s",
                     self._epoch, self._world_size, list(current.keys()))
+        self._publish_metrics()
         self._assign_cond.notify_all()
 
     def _spawn_missing(self):
@@ -285,7 +304,21 @@ class ElasticDriver:
         else:
             logger.warning("worker %s:%d failed with exit code %d", host,
                            local_rank, code)
+            _WORKER_FAILURES.inc()
             self._registry.record_failure(host, local_rank)
+
+    def _publish_metrics(self):
+        """Refresh the driver's registry snapshot in the rendezvous KV
+        so scrapers can read launcher-side metrics (epochs, worker
+        failures, world size) that no worker endpoint carries."""
+        if self._rendezvous is None or self._rendezvous.kvstore is None:
+            return
+        try:
+            self._rendezvous.kvstore.put(
+                METRICS_SCOPE, KEY_DRIVER_METRICS,
+                json.dumps(metrics.snapshot()).encode())
+        except Exception:
+            logger.debug("driver metrics publish failed", exc_info=True)
 
     def _discover_hosts(self):
         while not self._shutdown.is_set():
@@ -294,6 +327,7 @@ class ElasticDriver:
             except Exception:
                 logger.exception("host discovery failed; retrying")
                 changed = False
+            self._publish_metrics()
             if changed:
                 with self._lock:
                     self._generation += 1

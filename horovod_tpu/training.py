@@ -20,6 +20,7 @@ import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .common.jax_compat import shard_map
 from .models.bert import BertConfig, BertForMaskedLM, mlm_loss
 from .parallel.sharding import (bert_partition_rules, infer_shardings,
                                 Rules)
@@ -197,7 +198,7 @@ def run_pipeline_moe_dry_run(n_devices: int, microbatches: int = 4,
             lambda g: jax.lax.pmean(g, "dp"), grads)
         return jax.lax.pmean(loss, ("dp", "ep")), grads
 
-    run = jax.jit(jax.shard_map(
+    run = jax.jit(shard_map(
         grads_fn, mesh=mesh,
         in_specs=(P("pp"), P("pp"), P("pp", "ep"), P(None, "dp")),
         out_specs=(P(), (P("pp"), P("pp"), P("pp", "ep")))))
@@ -225,7 +226,7 @@ def run_ring_attention_dry_run(n_devices: int, seq_per_dev: int = 8,
         return jnp.mean(
             ring_attention(q, k, v, axis_name="sp", causal=True) ** 2)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         jax.grad(loss), mesh=mesh, in_specs=(P(None, "sp"),) * 3,
         out_specs=P(None, "sp")))
     g = f(q, k, v)

@@ -202,3 +202,31 @@ def test_tpu_pod_discovery_env(monkeypatch):
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
     monkeypatch.setattr(tpu_metadata, "_metadata_get", lambda *a: None)
     assert disc.find_available_hosts_and_slots() == {}
+
+
+def test_driver_publishes_metrics_to_rendezvous():
+    """Launcher-side metrics (epochs, world size, worker failures) are
+    only readable through the rendezvous KV: the driver must publish
+    its registry snapshot under metrics/driver."""
+    import json
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    workers = FakeWorkers()
+    rdv = RendezvousServer(secret="")      # open server: unit test
+    rdv.start()
+    try:
+        driver = ElasticDriver(rendezvous=rdv,
+                               discovery=FixedHosts({"a": 2}),
+                               min_np=2, timeout=5)
+        driver.start(2, workers.create)
+        raw = rdv.kvstore.get("metrics", "driver")
+        assert raw is not None, "driver never published its snapshot"
+        snap = json.loads(raw.decode())
+        assert snap["counters"]["hvd_elastic_epochs_total"] >= 1
+        assert snap["gauges"]["hvd_elastic_world_size"] == 2
+        workers.release_all(0)
+        assert driver.join(timeout=10)
+        driver.stop()
+    finally:
+        rdv.stop()

@@ -1,0 +1,345 @@
+"""Runtime metrics subsystem: registry semantics, hot-path
+instrumentation (hvd.metrics_snapshot() after real multi-op runs), the
+Prometheus /metrics endpoint incl. job-secret auth, and cross-rank
+aggregation over the control plane."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from multiproc import assert_all_ok, run_workers
+
+from horovod_tpu.common import metrics
+
+
+# ---------------------------------------------------------------------------
+# registry unit semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_snapshot():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("ops_total")
+    c.inc()
+    c.inc(2, op="ALLREDUCE")
+    g = reg.gauge("depth")
+    g.set(3)
+    g.inc()
+    h = reg.histogram("lat_seconds")
+    h.observe(1e-3)
+    h.observe(0.5)
+
+    snap = reg.snapshot()
+    assert snap["counters"]["ops_total"] == {"": 1.0,
+                                             "op=ALLREDUCE": 2.0}
+    assert snap["gauges"]["depth"] == 4.0
+    hist = snap["histograms"]["lat_seconds"]
+    assert hist["count"] == 2
+    assert hist["sum"] == pytest.approx(0.501)
+    assert hist["min"] == pytest.approx(1e-3)
+    assert hist["max"] == pytest.approx(0.5)
+    # Bucketed, bounded, and complete: totals equal the count.
+    assert hist["buckets"][-1][0] == "+Inf"
+    assert sum(cnt for _, cnt in hist["buckets"]) == 2
+    # Snapshot survives a JSON round trip (the MR-frame wire format).
+    assert json.loads(json.dumps(snap))["gauges"]["depth"] == 4.0
+
+    # get-or-create is idempotent; kind clashes are programming errors.
+    assert reg.counter("ops_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("ops_total")
+
+
+def test_histogram_bucket_assignment():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("h", bounds=metrics.log_bounds(1.0, 2.0, 3))
+    for v in (0.5, 1.0, 3.0, 100.0):     # le=1, le=1, le=4, +Inf
+        h.observe(v)
+    buckets = reg.snapshot()["histograms"]["h"]["buckets"]
+    assert buckets == [[1.0, 2], [2.0, 0], [4.0, 1], ["+Inf", 1]]
+
+
+def test_prometheus_rendering():
+    reg = metrics.MetricsRegistry()
+    reg.counter("c_total", "help text").inc(3, op="X", backend="ring")
+    reg.gauge("g").set(1.5)
+    reg.histogram("h_seconds",
+                  bounds=metrics.log_bounds(1.0, 10.0, 2)).observe(5.0)
+    text = reg.render_prometheus()
+    assert "# HELP c_total help text" in text
+    assert "# TYPE c_total counter" in text
+    assert 'c_total{backend="ring",op="X"} 3.0' in text
+    assert "g 1.5" in text
+    # Histogram: cumulative buckets + sum + count.
+    assert 'h_seconds_bucket{le="1.0"} 0' in text
+    assert 'h_seconds_bucket{le="10.0"} 1' in text
+    assert 'h_seconds_bucket{le="+Inf"} 1' in text
+    assert "h_seconds_sum 5.0" in text
+    assert "h_seconds_count 1" in text
+
+
+def test_merge_snapshots():
+    def make(n):
+        reg = metrics.MetricsRegistry()
+        reg.counter("c").inc(n)
+        reg.counter("labeled").inc(n, op="A")
+        reg.gauge("g").set(n)
+        h = reg.histogram("h", bounds=metrics.log_bounds(1.0, 2.0, 2))
+        h.observe(n)
+        return reg.snapshot()
+
+    merged = metrics.merge_snapshots([make(1), make(4)])
+    assert merged["counters"]["c"] == 5.0
+    assert merged["counters"]["labeled"] == {"op=A": 5.0}
+    assert merged["gauges"]["g"] == 5.0
+    h = merged["histograms"]["h"]
+    assert h["count"] == 2 and h["sum"] == 5.0
+    assert h["min"] == 1.0 and h["max"] == 4.0
+    assert h["buckets"] == [[1.0, 1], [2.0, 0], ["+Inf", 1]]
+
+
+def test_reset_keeps_registered_objects_live():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("c")
+    c.inc(7)
+    reg.reset()
+    assert c.value() == 0.0
+    c.inc()          # the same object keeps feeding the registry
+    assert reg.snapshot()["counters"]["c"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# single-process instrumentation through the real runtime
+# ---------------------------------------------------------------------------
+
+def test_single_process_ops_feed_snapshot(hvd_single):
+    hvd = hvd_single
+    metrics.reset()
+    for _ in range(3):
+        hvd.allreduce(np.ones((8,), np.float32), op=hvd.Sum,
+                      name="m/grad")
+    hvd.allgather(np.ones((2, 2), np.float32), name="m/gather")
+    snap = hvd.metrics_snapshot()
+    dispatched = snap["counters"]["hvd_responses_dispatched_total"]
+    assert dispatched["op=ALLREDUCE"] >= 3
+    assert dispatched["op=ALLGATHER"] >= 1
+    assert snap["counters"]["hvd_cycles_total"] >= 1
+    assert snap["histograms"]["hvd_cycle_seconds"]["count"] >= 1
+    assert snap["histograms"]["hvd_submit_latency_seconds"]["count"] >= 4
+    fused = snap["histograms"]["hvd_fusion_tensors_per_response"]
+    assert fused["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: 2 real processes, full control plane
+# ---------------------------------------------------------------------------
+
+_MULTIPROC_BODY = """
+import json as _json
+import urllib.request
+
+for step in range(6):
+    y = np.asarray(hvd.allreduce(np.ones((1024,), np.float32),
+                                 op=hvd.Sum, name="grad/w"))
+    np.testing.assert_allclose(y, 2.0)
+g = np.asarray(hvd.allgather(np.ones((RANK + 1, 2), np.float32),
+                             name="gather/x"))
+assert g.shape == (3, 2)
+
+snap = hvd.metrics_snapshot()
+print("METRICS " + _json.dumps(snap))
+
+from horovod_tpu.common import basics
+srv = basics._state().metrics_server
+assert srv is not None, "HOROVOD_METRICS_PORT should start the endpoint"
+text = urllib.request.urlopen(
+    "http://127.0.0.1:%d/metrics" % srv.port, timeout=10
+).read().decode()
+assert "# TYPE hvd_responses_dispatched_total counter" in text, text[:500]
+assert 'hvd_responses_dispatched_total{op="ALLREDUCE"}' in text
+assert "hvd_cycle_seconds_bucket" in text
+assert 'le="+Inf"' in text
+print("ENDPOINT_OK")
+hvd.shutdown()
+print("OK")
+"""
+
+
+def _labeled_sum(counter_child, want: str) -> float:
+    if isinstance(counter_child, dict):
+        return sum(v for k, v in counter_child.items() if want in k)
+    return counter_child
+
+
+def _hist_count(hist_child) -> int:
+    """Total observations of a histogram snapshot entry, labeled or
+    not (unlabeled entries are the child dict itself)."""
+    if "count" in hist_child and "buckets" in hist_child:
+        return hist_child["count"]
+    return sum(c["count"] for c in hist_child.values())
+
+
+@pytest.mark.multiproc
+def test_multiproc_metrics_snapshot_and_endpoint():
+    results = run_workers(_MULTIPROC_BODY, nproc=2,
+                          extra_env={"HOROVOD_METRICS_PORT": "0"})
+    assert_all_ok(results)
+    for rc, out in results:
+        assert "ENDPOINT_OK" in out, out[-2000:]
+    line = next(l for l in results[0][1].splitlines()
+                if l.startswith("METRICS "))
+    snap = json.loads(line[len("METRICS "):])
+
+    counters = snap["counters"]
+    # Ops by type.
+    assert _labeled_sum(counters["hvd_responses_dispatched_total"],
+                        "op=ALLREDUCE") >= 6
+    assert _labeled_sum(counters["hvd_responses_dispatched_total"],
+                        "op=ALLGATHER") >= 1
+    # Payload bytes moved on the data plane (6 × 4 KB allreduce alone).
+    assert _labeled_sum(counters["hvd_collective_bytes_total"],
+                        "op=ALLREDUCE") >= 6 * 4096
+    assert _labeled_sum(counters["hvd_collective_ops_total"],
+                        "op=ALLREDUCE") >= 6
+    # Cache hits: the same-signature allreduce repeats via the cache.
+    assert _labeled_sum(counters["hvd_response_cache_total"],
+                        "event=hit") >= 2
+    # Control-plane accounting.
+    assert counters["hvd_bytes_sent_total"] > 0
+    assert counters["hvd_bytes_recv_total"] > 0
+    assert _labeled_sum(counters["hvd_frames_recv_total"], "kind=") > 0
+    # Cycle-latency histogram populated by the background loop.
+    assert snap["histograms"]["hvd_cycle_seconds"]["count"] >= 1
+    assert snap["histograms"]["hvd_submit_latency_seconds"]["count"] >= 7
+    assert _hist_count(snap["histograms"]["hvd_collective_seconds"]) >= 7
+
+
+# ---------------------------------------------------------------------------
+# endpoint auth (job-secret HMAC, same contract as the rendezvous KV)
+# ---------------------------------------------------------------------------
+
+def test_metrics_endpoint_job_secret_auth():
+    from horovod_tpu.runner import job_secret
+
+    reg = metrics.MetricsRegistry()
+    reg.counter("sec_total").inc(5)
+    secret = job_secret.make_secret_key()
+    srv = metrics.serve(port=0, registry=reg, secret=secret)
+    try:
+        url = "http://127.0.0.1:%d/metrics" % srv.port
+        # Unsigned request: rejected.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 403
+        # Wrongly signed request: rejected.
+        ts = repr(time.time())
+        bad = urllib.request.Request(url, headers={
+            job_secret.TS_HEADER: ts,
+            job_secret.HEADER: job_secret.sign(
+                "not-the-secret", "GET", "/metrics", b"", ts)})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=10)
+        assert exc.value.code == 403
+        # Correctly signed request: served.
+        ts = repr(time.time())
+        good = urllib.request.Request(url, headers={
+            job_secret.TS_HEADER: ts,
+            job_secret.HEADER: job_secret.sign(
+                secret, "GET", "/metrics", b"", ts)})
+        with urllib.request.urlopen(good, timeout=10) as r:
+            text = r.read().decode()
+        assert "sec_total 5.0" in text
+        # Mutations are never accepted, signed or not.
+        ts = repr(time.time())
+        put = urllib.request.Request(url, data=b"x", method="PUT",
+                                     headers={
+            job_secret.TS_HEADER: ts,
+            job_secret.HEADER: job_secret.sign(
+                secret, "PUT", "/metrics", b"x", ts)})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(put, timeout=10)
+        assert exc.value.code == 405
+    finally:
+        srv.stop()
+
+
+def test_metrics_endpoint_open_without_secret_and_404():
+    reg = metrics.MetricsRegistry()
+    reg.gauge("g").set(1)
+    srv = metrics.serve(port=0, registry=reg, secret="")
+    try:
+        base = "http://127.0.0.1:%d" % srv.port
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert "g 1.0" in r.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/other", timeout=10)
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-rank aggregation over the control plane (MQ/MR frames)
+# ---------------------------------------------------------------------------
+
+_AGG_BODY = """
+import json as _json
+import time as _t
+
+for step in range(4):
+    y = np.asarray(hvd.allreduce(np.ones((64,), np.float32),
+                                 op=hvd.Sum, name="agg/w"))
+    np.testing.assert_allclose(y, 2.0)
+
+def _allreduce_total(merged):
+    c = merged["counters"].get("hvd_responses_dispatched_total", {})
+    if not isinstance(c, dict):
+        return c
+    return sum(v for k, v in c.items() if "ALLREDUCE" in k)
+
+
+if RANK == 0:
+    # Wait until the periodic MQ polls have caught every rank's FINAL
+    # counts (an early poll legitimately snapshots mid-run state).
+    merged = None
+    for _ in range(200):
+        merged = hvd.cluster_metrics_snapshot()
+        if merged and len(merged.get("ranks", [])) == SIZE and \
+                _allreduce_total(merged) >= 4 * SIZE:
+            break
+        _t.sleep(0.05)
+    assert merged is not None, "no per-rank snapshots collected"
+    assert merged["ranks"] == list(range(SIZE)), merged["ranks"]
+    print("CLUSTER " + _json.dumps(merged))
+else:
+    assert hvd.cluster_metrics_snapshot() is None
+# Non-leader ranks must stay attached (still answering MQ polls) until
+# rank 0 has collected everyone's FINAL counts; the barrier releases
+# them only once rank 0 is done.
+hvd.barrier()
+hvd.shutdown()
+print("OK")
+"""
+
+
+@pytest.mark.multiproc
+def test_cluster_aggregation_over_control_plane():
+    results = run_workers(_AGG_BODY, nproc=2, extra_env={
+        "HOROVOD_METRICS_AGG_SECONDS": "0.2"})
+    assert_all_ok(results)
+    line = next(l for l in results[0][1].splitlines()
+                if l.startswith("CLUSTER "))
+    merged = json.loads(line[len("CLUSTER "):])
+    # Both ranks dispatched every response: the merged count is the
+    # cross-rank SUM, i.e. at least 2 ranks x 4 allreduces.
+    assert _labeled_sum(merged["counters"]
+                        ["hvd_responses_dispatched_total"],
+                        "op=ALLREDUCE") >= 8
+    # Histograms merge bucket-wise: both ranks' submit latencies land
+    # in one distribution (4 submissions per rank).
+    lat = merged["histograms"]["hvd_submit_latency_seconds"]
+    assert lat["count"] >= 8
+    assert sum(cnt for _, cnt in lat["buckets"]) == lat["count"]

@@ -1,0 +1,90 @@
+"""StallInspector unit coverage (reference: stall_inspector.{h,cc}):
+warn once per stalled tensor, return it for cache invalidation, raise
+past the shutdown threshold, and re-warn after remove() + resubmit."""
+
+import logging
+import time
+
+import pytest
+
+from horovod_tpu.common import metrics
+from horovod_tpu.common.stall_inspector import StallInspector
+
+STALL_LOGGER = "horovod_tpu.stall"
+
+
+def _age(si: StallInspector, seconds: float):
+    """Backdate every tracked tensor instead of sleeping."""
+    si._uncompleted = {
+        name: (ts - seconds, ranks)
+        for name, (ts, ranks) in si._uncompleted.items()}
+
+
+def test_warning_once_per_tensor_and_invalidate_list(caplog):
+    si = StallInspector(warning_time_s=1.0, world_size=4)
+    si.record_uncached_tensor("grad/w", 0)
+    si.record_uncached_tensor("grad/w", 2)
+    si.record_uncached_tensor("grad/b", 1)
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        assert si.check() == []          # younger than the threshold
+        assert not caplog.records
+        _age(si, 2.0)
+        stalls_before = metrics.REGISTRY.counter(
+            "hvd_stall_warnings_total").value()
+        invalidate = si.check()
+    assert sorted(invalidate) == ["grad/b", "grad/w"]
+    assert len(caplog.records) == 1
+    msg = caplog.records[0].getMessage()
+    # Per-tensor attribution: ready vs waiting ranks.
+    assert "grad/w" in msg and "[ready: [0, 2], waiting: [1, 3]]" in msg
+    assert "grad/b" in msg and "[ready: [1], waiting: [0, 2, 3]]" in msg
+    assert metrics.REGISTRY.counter(
+        "hvd_stall_warnings_total").value() == stalls_before + 2
+    # Second check: already warned, nothing re-logged or re-invalidated.
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        assert si.check() == []
+    assert not caplog.records
+
+
+def test_shutdown_threshold_raises():
+    si = StallInspector(warning_time_s=1.0, shutdown_time_s=5.0,
+                        world_size=2)
+    si.record_uncached_tensor("stuck", 0)
+    _age(si, 2.0)
+    si.check()                           # warned, below shutdown
+    _age(si, 10.0)
+    with pytest.raises(RuntimeError, match="stuck.*shutdown threshold"):
+        si.check()
+
+
+def test_rewarn_after_remove_and_resubmit(caplog):
+    si = StallInspector(warning_time_s=1.0, world_size=2)
+    si.record_uncached_tensor("t", 0)
+    _age(si, 2.0)
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        assert si.check() == ["t"]
+    assert len(caplog.records) == 1
+    # Completion clears the warned set; a later stall of the SAME
+    # tensor must warn again (a recurring stall is new information).
+    si.remove("t")
+    si.record_uncached_tensor("t", 0)
+    assert si.check() == []              # fresh timestamp: not stalled
+    _age(si, 2.0)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        assert si.check() == ["t"]
+    assert len(caplog.records) == 1
+
+
+def test_cached_tensor_tracking_counts_as_waiting_on_all():
+    si = StallInspector(warning_time_s=1.0, world_size=2)
+    si.record_cached_tensor("c")         # rank -1 sentinel
+    _age(si, 2.0)
+    assert si.check() == ["c"]           # invalidate → renegotiation
+
+
+def test_remove_unknown_tensor_is_noop():
+    si = StallInspector(warning_time_s=1.0, world_size=2)
+    si.remove("never-seen")
+    assert si.check() == []

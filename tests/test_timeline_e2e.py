@@ -3,10 +3,16 @@ valid chrome-tracing JSON with negotiation + execution spans
 (reference: test/parallel/test_timeline.py — run a job under
 HOROVOD_TIMELINE and validate the JSON)."""
 
+import importlib.util
 import json
 import os
 
-from multiproc import assert_all_ok, run_workers
+from multiproc import REPO, assert_all_ok, run_workers
+
+_SPEC = importlib.util.spec_from_file_location(
+    "validate_trace", os.path.join(REPO, "tools", "validate_trace.py"))
+validate_trace = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(validate_trace)
 
 
 def test_timeline_2proc_valid_chrome_json(tmp_path):
@@ -55,6 +61,8 @@ def test_timeline_2proc_valid_chrome_json(tmp_path):
     # Timestamps are monotone non-negative microseconds.
     ts = [e["ts"] for e in events if "ts" in e]
     assert all(t >= 0 for t in ts)
+    # The standalone well-formedness checker agrees.
+    assert validate_trace.validate_file(str(tl)) == []
 
 
 def test_timeline_runtime_start_stop(tmp_path):
@@ -80,3 +88,60 @@ def test_timeline_runtime_start_stop(tmp_path):
     meta = {e["args"]["name"] for e in events if e.get("ph") == "M"}
     assert "mid" in meta, (spans, meta)
     assert "post" not in meta, "events after stop_timeline leaked"
+    assert validate_trace.validate_file(str(tl)) == []
+
+
+def test_timeline_writer_failure_disables_enqueue(tmp_path, caplog):
+    """Writer-thread death (unopenable path) must mark the writer
+    inactive and log once — NOT keep queueing records unbounded."""
+    import logging
+    import time as _time
+
+    from horovod_tpu.common.timeline import TimelineWriter
+
+    bad = tmp_path / "not_a_dir"
+    bad.write_text("")          # a FILE where a directory is needed
+    with caplog.at_level(logging.WARNING,
+                         logger="horovod_tpu.timeline"):
+        w = TimelineWriter(str(bad / "timeline.json"))
+        deadline = _time.monotonic() + 5.0
+        while w._active and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+    assert not w._active, "writer death must deactivate enqueue"
+    assert any("timeline writer failed" in r.getMessage()
+               for r in caplog.records)
+    for _ in range(100):
+        w.enqueue({"ph": "B"})
+    assert w._queue.qsize() == 0, "records queued after writer death"
+    w.close()                   # must not hang on the dead thread
+
+
+def test_validate_trace_rejects_malformed(tmp_path):
+    """The checker actually fails on the defect classes it covers."""
+    cases = {
+        "unbalanced": [{"ph": "B", "name": "x", "pid": 0, "tid": 1,
+                        "ts": 1.0}],
+        "e_without_b": [{"ph": "E", "pid": 0, "tid": 1, "ts": 1.0}],
+        "backwards_ts": [
+            {"ph": "B", "name": "x", "pid": 0, "tid": 1, "ts": 5.0},
+            {"ph": "E", "pid": 0, "tid": 1, "ts": 2.0}],
+        "negative_ts": [{"ph": "B", "name": "x", "pid": 0, "tid": 1,
+                         "ts": -1.0},
+                        {"ph": "E", "pid": 0, "tid": 1, "ts": 1.0}],
+        "not_a_list": {"ph": "B"},
+    }
+    for name, events in cases.items():
+        p = tmp_path / (name + ".json")
+        p.write_text(json.dumps(events))
+        assert validate_trace.validate_file(str(p)) != [], name
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps([
+        {"name": "thread_name", "ph": "M", "pid": 0, "tid": 1,
+         "args": {"name": "t"}},
+        {"ph": "B", "name": "NEGOTIATE_ALLREDUCE", "pid": 0, "tid": 1,
+         "ts": 1.0},
+        {"ph": "E", "pid": 0, "tid": 1, "ts": 2.0},
+        {"ph": "C", "name": "queue_depth", "pid": 0, "tid": 0,
+         "ts": 2.5, "args": {"pending": 3}},
+    ]))
+    assert validate_trace.validate_file(str(ok)) == []

@@ -1,0 +1,116 @@
+"""Chrome-trace well-formedness checker for Horovod timeline output.
+
+Validates the JSON the TimelineWriter produces (common/timeline.py)
+against the chrome://tracing event-format rules this repo relies on:
+
+  * top level is an array of event objects, each with a phase ``ph``;
+  * duration events balance: every ``E`` has a matching earlier ``B``
+    on the same tid, and no tid ends with an open span;
+  * timestamps are non-negative numbers, and B/E timestamps are
+    non-decreasing per tid (spans come from causally ordered
+    lifecycle transitions of one tensor);
+  * metadata (``M``) events carry ``args.name`` (the tid→tensor map);
+  * counter (``C``) events carry an ``args`` dict of numeric series.
+
+Usable as a library (``validate_events`` / ``validate_file`` return a
+list of error strings, empty = valid) and as a CLI::
+
+    python tools/validate_trace.py /tmp/timeline.json [...]
+"""
+
+import json
+import sys
+from typing import List
+
+# Phases that are valid but carry no structure we verify beyond ts.
+_PASSTHROUGH_PHASES = {"i", "I", "X", "b", "e", "n", "s", "t", "f",
+                       "N", "O", "D", "P"}
+
+
+def validate_events(events) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(events, list):
+        return ["top-level JSON must be an array of trace events"]
+    depth = {}      # tid -> open B count
+    last_ts = {}    # tid -> last B/E timestamp
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or "ph" not in e:
+            errors.append("event %d: not an object with a 'ph' phase"
+                          % i)
+            continue
+        ph = e["ph"]
+        if ph == "M":
+            if not isinstance(e.get("args"), dict) or \
+                    "name" not in e["args"]:
+                errors.append("event %d: metadata without args.name"
+                              % i)
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool) \
+                or ts < 0:
+            errors.append("event %d: missing or negative ts (%r)"
+                          % (i, ts))
+            continue
+        tid = e.get("tid", 0)
+        if ph in ("B", "E"):
+            if ts < last_ts.get(tid, 0.0):
+                errors.append(
+                    "event %d: ts moved backwards on tid %r "
+                    "(%r < %r)" % (i, tid, ts, last_ts[tid]))
+            last_ts[tid] = max(last_ts.get(tid, 0.0), ts)
+            if ph == "B":
+                if "name" not in e:
+                    errors.append("event %d: 'B' without a name" % i)
+                depth[tid] = depth.get(tid, 0) + 1
+            else:
+                depth[tid] = depth.get(tid, 0) - 1
+                if depth[tid] < 0:
+                    errors.append(
+                        "event %d: 'E' without a matching 'B' on "
+                        "tid %r" % (i, tid))
+                    depth[tid] = 0
+        elif ph == "C":
+            args = e.get("args")
+            if not isinstance(args, dict) or not all(
+                    isinstance(v, (int, float)) and
+                    not isinstance(v, bool)
+                    for v in args.values()):
+                errors.append(
+                    "event %d: 'C' without a numeric args dict" % i)
+        elif ph not in _PASSTHROUGH_PHASES:
+            errors.append("event %d: unknown phase %r" % (i, ph))
+    for tid, d in sorted(depth.items(), key=lambda kv: str(kv[0])):
+        if d != 0:
+            errors.append("tid %r: %d unclosed 'B' span(s)" % (tid, d))
+    return errors
+
+
+def validate_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            events = json.load(f)
+    except (OSError, ValueError) as e:
+        return ["%s: unreadable or invalid JSON: %s" % (path, e)]
+    return validate_events(events)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: validate_trace.py TIMELINE_JSON [...]",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        errors = validate_file(path)
+        if errors:
+            rc = 1
+            for err in errors:
+                print("%s: %s" % (path, err))
+        else:
+            print("%s: OK" % path)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
