@@ -715,6 +715,83 @@ def load_last_tpu():
         return None
 
 
+def _load_prior_smoke(repo_dir: str):
+    """Smoke headline (images_per_sec, spread_pct, source file) from
+    the most recent prior round's BENCH_r*.json.  Driver artifacts wrap
+    the bench JSON ({"rc", "tail", "parsed", ...}) and the tail may be
+    truncated at the front, so fall back to regexing the smoke section
+    out of the text."""
+    import glob
+    import re
+    arts = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                raw = f.read()
+            data = json.loads(raw)
+        except (OSError, ValueError):
+            continue
+        candidates = []
+        if isinstance(data, dict):
+            if isinstance(data.get("parsed"), dict):
+                candidates.append(data["parsed"])
+            candidates.append(data)  # a bare bench JSON line
+        for d in candidates:
+            smoke = d.get("resnet18_smoke")
+            if isinstance(smoke, dict) and smoke.get("images_per_sec"):
+                return {"images_per_sec": smoke["images_per_sec"],
+                        "spread_pct": smoke.get("spread_pct", 0.0),
+                        "source": os.path.basename(path)}
+        m = re.search(
+            r'\\?"resnet18_smoke\\?":\s*\{(.*?)\}', raw, re.S)
+        if m:
+            body = m.group(1).replace("\\\"", "\"")
+            img = re.search(r'"images_per_sec":\s*([0-9.]+)', body)
+            spread = re.search(r'"spread_pct":\s*([0-9.]+)', body)
+            # Zero headline = a failed prior smoke; useless (and
+            # divide-by-zero-dangerous) as a baseline — keep looking.
+            if img and float(img.group(1)) > 0:
+                return {"images_per_sec": float(img.group(1)),
+                        "spread_pct": float(spread.group(1))
+                        if spread else 0.0,
+                        "source": os.path.basename(path)}
+    return None
+
+
+def check_smoke_regression(out: dict, repo_dir: str):
+    """Warn when the CPU smoke headline regresses by more than its own
+    measured noise vs the prior round's artifact (round-5 lesson: a
+    13% smoke regression shipped silently because nothing compared
+    rounds).  The tolerance is the LARGER of the two runs' spread_pct
+    (never below 5%): a drop inside scheduler noise is not a finding.
+    Records the comparison in the artifact either way."""
+    cur = out.get("resnet18_smoke") or {}
+    cur_img = cur.get("images_per_sec")
+    if not cur_img:
+        return
+    prior = _load_prior_smoke(repo_dir)
+    if prior is None or not prior["images_per_sec"]:
+        return
+    tol_pct = max(float(cur.get("spread_pct") or 0.0),
+                  float(prior["spread_pct"] or 0.0), 5.0)
+    delta_pct = (cur_img - prior["images_per_sec"]) \
+        / prior["images_per_sec"] * 100.0
+    cmp = {
+        "prior_images_per_sec": prior["images_per_sec"],
+        "prior_source": prior["source"],
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": round(tol_pct, 1),
+        "regressed": delta_pct < -tol_pct,
+    }
+    out["smoke_vs_prior"] = cmp
+    if cmp["regressed"]:
+        print("WARNING: CPU smoke headline regressed %.1f%% vs %s "
+              "(%.2f -> %.2f img/s), beyond the %.1f%% noise band"
+              % (-delta_pct, prior["source"],
+                 prior["images_per_sec"], cur_img, tol_pct),
+              file=sys.stderr)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true",
@@ -816,6 +893,9 @@ def main():
         except Exception as e:
             out["allreduce_eager"] = {"error": repr(e)[:300]}
 
+    if args.smoke:
+        check_smoke_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
     img_sec = resnet.get("images_per_sec", 0.0)
     out.update({
         "metric": "resnet50_images_per_sec_per_chip" if not args.smoke

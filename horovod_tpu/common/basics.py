@@ -237,6 +237,12 @@ def init(comm=None, process_sets=None):
             state.distributed_client_owned = _maybe_init_jax_distributed(
                 state.rank_info)
 
+        # Failpoint rank= predicates resolve against the final rank of
+        # this incarnation (elastic rendezvous above may have changed
+        # the env contract since import time).
+        from . import failpoints
+        failpoints.set_rank(state.rank_info.rank)
+
         from ..ops.backend import create_backend
         state.backend = create_backend(state)
 

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from . import failpoints as _fp
 from . import metrics
 from .controller import Controller, MessageTable, construct_response
 from .fusion import fuse_responses
@@ -144,6 +145,8 @@ class CoordinatorServer:
         self._last_joined = -1
         # barrier (psid, name) -> ranks arrived
         self._barriers: Dict[tuple, Set[int]] = {}
+        # barrier (psid, name) -> member ranks (for stall attribution)
+        self._barrier_members: Dict[tuple, Tuple[int, ...]] = {}
         # --- response-cache fast path (reference controller.cc:81-236) ---
         self._cache = CoordinatorCache(cache_capacity)
         # (psid, name) -> True while every contribution this round came
@@ -274,6 +277,15 @@ class CoordinatorServer:
                 if frame is None:
                     return
                 magic, payload = frame
+                # Failpoint site: uplink frame arrival on the
+                # coordinator.  drop() discards the frame (the sender's
+                # tensor goes incomplete — the stall machinery must
+                # attribute and fail it); error() kills this rank loop,
+                # which the coordinator treats as the rank departing.
+                if _fp.ENABLED and \
+                        _fp.maybe_fail("coord.frame_recv",
+                                       rank=rank) == "drop":
+                    continue
                 _FRAMES_RECV.inc(1, kind=magic.decode("ascii",
                                                       "replace"))
                 _BYTES_RECV.inc(len(payload) + 6)
@@ -367,6 +379,7 @@ class CoordinatorServer:
             self._pre_formed.clear()
             self._table.entries.clear()
             self._barriers.clear()
+            self._barrier_members.clear()
             self._first_seen.clear()
             self._bit_only.clear()
             msg = (f"rank {rank} left the job "
@@ -544,8 +557,16 @@ class CoordinatorServer:
                 required = self._required_for(req) or self.size
                 arrived = self._barriers.setdefault(key, set())
                 arrived.add(rank)
+                # Barriers live outside the message table, so they need
+                # their own stall clock: a rank dying at a barrier must
+                # surface through attribution + shutdown like any other
+                # collective, not hang the arrived ranks forever.
+                self._first_seen.setdefault(key, time.monotonic())
+                self._barrier_members[key] = req.process_set_ranks
                 if len(arrived) >= required:
                     del self._barriers[key]
+                    self._barrier_members.pop(key, None)
+                    self._first_seen.pop(key, None)
                     ready.append((key, None, Response(
                         response_type=ResponseType.BARRIER,
                         tensor_names=[name],
@@ -713,6 +734,22 @@ class CoordinatorServer:
             self._pending_evictions = []
 
     def _broadcast_frame_locked(self, magic: bytes, payload: bytes):
+        # Failpoint site: coordinator broadcast fan-out.  drop()
+        # suppresses one whole downlink frame — every rank misses it,
+        # the negotiation wedges, and the stall shutdown must fail the
+        # collective rather than hang the job.  error() degrades to
+        # the same drop semantics: a raise here would propagate into
+        # whichever caller holds the lock (rank loops, the stall and
+        # metrics threads) and permanently kill the very machinery
+        # that bounds the fault.
+        if _fp.ENABLED:
+            try:
+                if _fp.maybe_fail("coord.broadcast") == "drop":
+                    return
+            except _fp.FailpointError:
+                logger.warning("failpoint coord.broadcast: injected "
+                               "error; dropping the frame")
+                return
         dead = []
         for r, conn in self._conns.items():
             try:
@@ -771,7 +808,8 @@ class CoordinatorServer:
 
     def stall_report(self) -> List[Tuple[str, List[int], List[int], float]]:
         """(tensor, submitted_ranks, missing_ranks, age_s) for every
-        tensor pending longer than the warning threshold."""
+        tensor — including pending barriers — stuck longer than the
+        warning threshold."""
         now = time.monotonic()
         out = []
         with self._lock:
@@ -786,6 +824,14 @@ class CoordinatorServer:
                 missing = sorted(set(members) - set(submitted)
                                  - self._joined)
                 out.append((key, submitted, missing, now - ts))
+            for key, arrived in self._barriers.items():
+                ts = self._first_seen.get(key)
+                if ts is None or now - ts < self._stall_warning_s:
+                    continue
+                members = self._barrier_members.get(key) or \
+                    range(self.size)
+                missing = sorted(set(members) - arrived - self._joined)
+                out.append((key, sorted(arrived), missing, now - ts))
         return out
 
     def _stall_loop(self):
@@ -810,9 +856,15 @@ class CoordinatorServer:
                         self._stall_shutdown_s)
                     with self._lock:
                         msgs = self._table.pop(key)
+                        # Barriers stall too (tracked outside the
+                        # message table); fail the arrived ranks the
+                        # same way.
+                        stalled_barrier = \
+                            self._barriers.pop(key, None) is not None
+                        self._barrier_members.pop(key, None)
                         self._first_seen.pop(key, None)
                         self._bit_only.pop(key, None)
-                        if msgs:
+                        if msgs or stalled_barrier:
                             self._broadcast_locked([Response(
                                 response_type=ResponseType.ERROR,
                                 tensor_names=[name],
@@ -961,8 +1013,17 @@ class NetworkController(Controller):
                 "HOROVOD_METRICS_AGG_SECONDS>0: cross-rank metrics "
                 "aggregation requires the Python coordinator (MQ/MR "
                 "frames).  Unset one of the two.")
+        # Armed failpoints pin the Python coordinator: the native C++
+        # coordinator carries no injection sites, and a fault schedule
+        # that silently skipped its coord.*/worker.* rules would report
+        # a vacuous pass.  Strict-native + failpoints is a config error.
+        if strict_native and _fp.ENABLED:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_FAILPOINTS: fault injection requires the "
+                "Python coordinator.  Unset one of the two.")
         if state.timeline is None and param_manager is None and \
-                metrics_interval <= 0:
+                metrics_interval <= 0 and not _fp.ENABLED:
             try:
                 from ..native import NativeCoordinatorServer, available
                 if strict_native and not available():
@@ -1093,6 +1154,23 @@ class NetworkController(Controller):
                         "(membership changed or rank 0 exited)"))
                 return
             magic, payload = frame
+            # Failpoint site: downlink frame arrival on a worker.
+            # drop() loses one response/cache frame for THIS rank only
+            # — it falls out of lockstep with its peers, the shape of
+            # desync the coordinator's attribution must survive.
+            # error() models a corrupt/dead downlink and must route
+            # through the broken-connection path: letting it kill this
+            # recv thread bare would leave blocked synchronize()
+            # callers hanging with no one to fail them.
+            if _fp.ENABLED:
+                try:
+                    if _fp.maybe_fail("worker.frame_recv",
+                                      rank=self.rank) == "drop":
+                        continue
+                except _fp.FailpointError as e:
+                    from .exceptions import HorovodInternalError
+                    self._set_broken(HorovodInternalError(str(e)))
+                    return
             self.stats["bytes_recv"] += len(payload) + 6
             _BYTES_RECV.inc(len(payload) + 6)
             _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
@@ -1146,6 +1224,14 @@ class NetworkController(Controller):
         """One uplink frame + its stats-dict and registry accounting in
         lockstep (caller holds self._send_lock) — the single place the
         frame-header byte math lives on the send side."""
+        # Failpoint site: worker uplink.  drop() swallows the RQ/CH
+        # frame before the socket — the coordinator never learns this
+        # rank is ready, so the tensor must surface through rank-0
+        # stall attribution, not a hang.
+        if _fp.ENABLED and \
+                _fp.maybe_fail("worker.frame_send",
+                               rank=self.rank) == "drop":
+            return
         _send_frame(self._sock, magic, payload)
         self.stats[stat_key] = self.stats.get(stat_key, 0) + 1
         self.stats["bytes_sent"] += len(payload) + 6

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import failpoints as _fp
 from . import metrics
 from . import timeline as tl
 from .controller import LoopbackController
@@ -133,6 +134,12 @@ class BackgroundRuntime:
     def submit(self, request: Request, entry: TensorTableEntry):
         if self._error is not None:
             raise self._error
+        if _fp.ENABLED:
+            # Failpoint site: eager submission, on the caller's thread.
+            # delay() models framework-side jitter; error() a rank that
+            # dies mid-step (the chaos harness crashes ranks here).
+            _fp.maybe_fail("runtime.submit",
+                           rank=self.state.rank_info.rank)
         entry.callback = _latency_wrapped(entry.callback)
         nelem = 1
         for d in request.tensor_shape:
@@ -308,6 +315,12 @@ class BackgroundRuntime:
                 self._on_fatal(e)
 
     def _run_once(self):
+        if _fp.ENABLED:
+            # Failpoint site: one background work cycle.  delay()
+            # stretches the negotiation cadence; error() is fatal to
+            # the incarnation (the _loop error contract).
+            _fp.maybe_fail("runtime.cycle",
+                           rank=self.state.rank_info.rank)
         _CYCLES.inc()
         if self.timeline:
             self.timeline.mark_cycle_start()

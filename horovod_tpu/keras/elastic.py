@@ -19,6 +19,32 @@ def _reset():
     g = sys.modules.get("horovod_tpu.tensorflow.graph_ops")
     if g is not None and g._ctx.elastic_graph:
         g.reset_graph_collectives()
+    _rebuild_data_parallel()
+
+
+def _rebuild_data_parallel():
+    """Re-form an installed ``keras.distribution`` DataParallel after a
+    resize: the in-graph SPMD plane (``hvd.keras.set_data_parallel``)
+    holds a DeviceMesh built over the PREVIOUS incarnation's global
+    devices — after shutdown/init re-formed the jax world, that mesh is
+    dead, and the next ``model.fit`` would device_put onto it.  Rebuild
+    the distribution over the new world's devices, mirroring
+    set_data_parallel (auto-sharding off, same batch axis)."""
+    try:
+        from keras import distribution as kd
+    except Exception:
+        return
+    dist = kd.distribution()
+    if dist is None or not isinstance(dist, kd.DataParallel):
+        return
+    import jax
+    devs = list(jax.devices())
+    old_axes = getattr(getattr(dist, "device_mesh", None),
+                       "axis_names", None)
+    axis = old_axes[0] if old_axes else "batch"
+    mesh = kd.DeviceMesh((len(devs),), [axis], devices=devs)
+    kd.set_distribution(kd.DataParallel(device_mesh=mesh,
+                                        auto_shard_dataset=False))
 
 
 def run(func):

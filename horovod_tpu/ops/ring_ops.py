@@ -23,6 +23,7 @@ from typing import List
 
 import numpy as np
 
+from ..common import failpoints as _fp
 from ..common import metrics
 from .backend import Backend, even_row_counts
 
@@ -190,11 +191,11 @@ class RingBackend(Backend):
         my_addr = None
         err = None
         try:
-            if os.environ.get("HOROVOD_RING_TEST_FAIL_RANK") == \
-                    str(self.rank):
-                # Test-only fault injection: exercises the unanimous
-                # demotion protocol (see tests/test_ring_backend.py).
-                raise RuntimeError("test-injected ring failure")
+            if _fp.ENABLED:
+                # Failpoint site: `ring.setup=error(rank=N)` exercises
+                # the unanimous demotion protocol (see
+                # tests/test_ring_backend.py, docs/fault_injection.md).
+                _fp.maybe_fail("ring.setup", rank=self.rank)
             if lib is None:
                 raise RuntimeError("native library unavailable")
             _bind(lib)
@@ -347,6 +348,12 @@ class RingBackend(Backend):
         under the same lock, so a collective that acquired the lock
         after close() must re-check before handing the pointer to C
         (hvd_ring_* dereference it unchecked)."""
+        if _fp.ENABLED:
+            # Failpoint site on the transport funnel (every native ring
+            # dispatch passes here): delay() models a slow wire, error()
+            # a failed collective.  Runs under the fusion lock, so an
+            # injected delay back-pressures exactly like a real stall.
+            _fp.maybe_fail("ring.send", rank=self.rank)
         if self._comm is None:
             raise RuntimeError("ring backend is closed")
         return self._comm

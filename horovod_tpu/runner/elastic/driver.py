@@ -22,6 +22,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import failpoints as _fp
 from ...common import metrics
 from ..hosts import (HostInfo, INVALID_SLOT_INFO, SlotInfo,
                      get_host_assignments)
@@ -275,6 +276,19 @@ class ElasticDriver:
 
         def monitor():
             try:
+                # Failpoint site: worker lifecycle, evaluated where the
+                # driver owns the spawn.  crash()/error() stand in for
+                # a worker that dies before (or instead of) running —
+                # the registry records the failure and the reset
+                # machinery replans, exactly as for a real non-zero
+                # exit.  crash_ok: the DRIVER must survive; it is the
+                # worker's death being modeled.
+                if _fp.ENABLED and _fp.maybe_fail(
+                        "elastic.worker", rank=slot.rank, epoch=epoch,
+                        crash_ok=True) == "crash":
+                    raise _fp.FailpointError(
+                        "elastic.worker: injected worker crash "
+                        "(rank %d, epoch %d)" % (slot.rank, epoch))
                 code = self._create_worker_fn(slot)
             except Exception:
                 logger.exception("worker launch failed for %s", key)

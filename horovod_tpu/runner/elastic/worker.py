@@ -16,6 +16,7 @@ import time
 from typing import Dict, Optional
 
 from ...common import env as env_mod
+from ...common import failpoints as _fp
 from ...common.elastic import HostUpdateSource
 from ..http_server import RendezvousClient
 
@@ -52,6 +53,14 @@ def elastic_rendezvous(timeout: Optional[float] = None) -> Dict:
     Raises HostsRemovedError when the slot was retired.
     """
     global _last_epoch
+    if _fp.ENABLED:
+        # Failpoint site: worker-side re-rendezvous.  delay() models a
+        # worker slow to rejoin after a resize; error() one that fails
+        # its rendezvous (the retry loop treats it like any init
+        # failure); crash() kills the worker process for real —
+        # `elastic.rendezvous=crash(epoch=2)` is the env-contract way
+        # to fault a live pod's second epoch.
+        _fp.maybe_fail("elastic.rendezvous", epoch=_last_epoch + 1)
     client = _client()
     hostname = os.environ.get(env_mod.HOROVOD_HOSTNAME, "localhost")
     local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))

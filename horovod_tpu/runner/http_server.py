@@ -23,6 +23,7 @@ from typing import Dict, Optional, Tuple
 from urllib.error import HTTPError
 from urllib.request import Request as UrlRequest, urlopen
 
+from ..common import failpoints as _fp
 from . import job_secret
 
 logger = logging.getLogger("horovod_tpu.rendezvous")
@@ -31,6 +32,7 @@ OK = 200
 NOT_FOUND = 404
 BAD_REQUEST = 400
 FORBIDDEN = 403
+SERVER_ERROR = 500
 
 
 class KVStore:
@@ -140,6 +142,22 @@ class KVStoreHandler(BaseHTTPRequestHandler):
                 return True
         return self._reject(FORBIDDEN)
 
+    def _failpoint_gate(self) -> bool:
+        """Failpoint site: one rendezvous KV request.  drop() closes
+        the connection without answering (a lost datagram — clients
+        retry); error() answers 500 (a driver-side fault — clients see
+        HTTPError, an OSError, and their poll loops ride it out);
+        delay() stalls the reply.  False = abort request handling."""
+        if not _fp.ENABLED:
+            return True
+        try:
+            if _fp.maybe_fail("rendezvous.request") == "drop":
+                self.close_connection = True
+                return False
+        except _fp.FailpointError:
+            return self._reject(SERVER_ERROR)
+        return True
+
     def _reject(self, code: int) -> bool:
         # A rejected PUT may have unread body bytes on the socket;
         # keep-alive would misparse them as the next request line.
@@ -170,7 +188,7 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         return True
 
     def do_GET(self):
-        if not self._authorized():
+        if not self._failpoint_gate() or not self._authorized():
             return
         scope, key = self._split()
         special = self.handle_get_special(scope, key)
@@ -187,6 +205,8 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_PUT(self):
+        if not self._failpoint_gate():
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
@@ -204,7 +224,7 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
-        if not self._authorized():
+        if not self._failpoint_gate() or not self._authorized():
             return
         scope, _ = self._split()
         self.server.kvstore.finalize(scope)

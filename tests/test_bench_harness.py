@@ -150,3 +150,72 @@ def test_probe_total_wall_cap(bench, monkeypatch):
     # need 90 + 120 more and is capped.
     assert len(diag["attempts"]) == 2
     assert diag.get("capped") is True
+
+
+def test_smoke_regression_warns_beyond_spread(bench, tmp_path, capsys):
+    """The CPU smoke headline must be compared against the prior
+    round's artifact and flagged when it drops beyond the larger run's
+    own spread_pct (round-5: a 13% smoke regression shipped silently)."""
+    # Driver-wrapper artifact with a tail-embedded (front-truncated)
+    # bench JSON — the shape real BENCH_r*.json files have.
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps({
+        "n": 7, "rc": 0, "parsed": None,
+        "tail": '..."resnet18_smoke": {"images_per_sec": 30.0, '
+                '"batch_size": 8, "spread_pct": 6.0}, "other": 1}'}))
+    out = {"resnet18_smoke": {"images_per_sec": 20.0,
+                              "spread_pct": 4.0}}
+    bench.check_smoke_regression(out, str(tmp_path))
+    cmp = out["smoke_vs_prior"]
+    assert cmp["regressed"] is True
+    assert cmp["prior_source"] == "BENCH_r07.json"
+    assert cmp["tolerance_pct"] == 6.0      # the larger spread wins
+    assert "regressed" in capsys.readouterr().err
+
+    # Within the noise band: recorded, not flagged.
+    out = {"resnet18_smoke": {"images_per_sec": 28.8,
+                              "spread_pct": 4.0}}
+    bench.check_smoke_regression(out, str(tmp_path))
+    assert out["smoke_vs_prior"]["regressed"] is False
+
+    # Improvements never warn.
+    out = {"resnet18_smoke": {"images_per_sec": 40.0,
+                              "spread_pct": 4.0}}
+    bench.check_smoke_regression(out, str(tmp_path))
+    assert out["smoke_vs_prior"]["regressed"] is False
+
+
+def test_smoke_regression_without_prior_is_silent(bench, tmp_path):
+    out = {"resnet18_smoke": {"images_per_sec": 20.0}}
+    bench.check_smoke_regression(out, str(tmp_path))
+    assert "smoke_vs_prior" not in out
+
+
+def test_smoke_regression_parses_parsed_artifact(bench, tmp_path):
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "rc": 0, "tail": "",
+        "parsed": {"resnet18_smoke": {"images_per_sec": 25.0,
+                                      "spread_pct": 3.0}}}))
+    out = {"resnet18_smoke": {"images_per_sec": 26.0,
+                              "spread_pct": 2.0}}
+    bench.check_smoke_regression(out, str(tmp_path))
+    assert out["smoke_vs_prior"]["prior_images_per_sec"] == 25.0
+
+
+def test_smoke_regression_skips_zero_headline_prior(bench, tmp_path):
+    """A failed prior smoke (images_per_sec 0) must be skipped as a
+    baseline, via both the regex and dict paths — never divided by."""
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "rc": 1, "parsed": None,
+        "tail": '..."resnet18_smoke": {"images_per_sec": 0.0, '
+                '"spread_pct": 0.0}...'}))
+    out = {"resnet18_smoke": {"images_per_sec": 20.0,
+                              "spread_pct": 4.0}}
+    bench.check_smoke_regression(out, str(tmp_path))
+    assert "smoke_vs_prior" not in out
+    # An older GOOD round behind the failed one is still found.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "rc": 0, "tail": "",
+        "parsed": {"resnet18_smoke": {"images_per_sec": 25.0,
+                                      "spread_pct": 3.0}}}))
+    bench.check_smoke_regression(out, str(tmp_path))
+    assert out["smoke_vs_prior"]["prior_images_per_sec"] == 25.0

@@ -1,0 +1,96 @@
+"""Tier-1 chaos smoke: a short, fully deterministic slice of the chaos
+soak harness (tools/chaos_soak.py) — the real 8-rank negotiation
+protocol under two seeded schedules, asserting zero hangs, bit-correct
+results, and bounded recovery in a few seconds.  Full randomized soaks
+live behind the `slow` marker (test_chaos_soak_full)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from chaos_soak import (BASELINE_SPEC, generate_schedule,  # noqa: E402
+                        run_schedule, run_soak)
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_baseline_8_ranks():
+    """No-fault control lane: 8 in-process ranks through the real
+    coordinator; every collective completes and reduces correctly."""
+    rec = run_schedule(
+        {"index": 0, "spec": BASELINE_SPEC, "seed": 7,
+         "kind": "baseline"},
+        ranks=8, n_ops=12, hang_timeout_s=30.0, stall_shutdown_s=2.0)
+    assert rec["outcome"] == "ok", rec
+    assert rec["ops_ok"] == [12] * 8
+    assert not rec["hangs"] and not rec["incorrect"]
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_drop_recovers_8_ranks():
+    """A dropped uplink frame on one rank: rank-0 stall attribution
+    must FAIL the wedged collective within the shutdown threshold and
+    a rebuilt world must verify — no hang, bounded recovery."""
+    rec = run_schedule(
+        {"index": 1, "spec": "worker.frame_send=drop(1,after=4,rank=3)",
+         "seed": 3, "kind": "fault"},
+        ranks=8, n_ops=12, hang_timeout_s=30.0, stall_shutdown_s=2.0)
+    assert rec["outcome"] == "recovered", rec
+    assert not rec["hangs"] and not rec["incorrect"]
+    assert rec["failures"], "the drop must surface as a detected error"
+    assert rec["recovery_latency_s"] is not None
+    assert rec["recovery_latency_s"] < 30.0
+    trig = rec["failpoint_triggers"]["worker.frame_send"][0]
+    assert trig["triggers"] == 1
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_injected_crash_recovers_8_ranks():
+    """A rank crashing mid-step: the elastic broken-membership path
+    (ERROR + AB fan-out) must unwind every survivor, and the next
+    incarnation must verify."""
+    rec = run_schedule(
+        {"index": 2,
+         "spec": "runtime.submit=crash(after=3,times=1,rank=5)",
+         "seed": 11, "kind": "fault"},
+        ranks=8, n_ops=12, hang_timeout_s=30.0, stall_shutdown_s=2.0)
+    assert rec["outcome"] == "recovered", rec
+    assert any(f.get("crashed") for f in rec["failures"]), rec
+    assert rec["recovery_latency_s"] is not None
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_full():
+    """The full randomized (but seeded) soak: several schedules at 8
+    ranks, artifact shape included."""
+    report = run_soak(ranks=8, schedules=6, seed=21, n_ops=25,
+                      stall_shutdown_s=2.0)
+    assert report["ok"], report["outcomes"]
+    assert report["schedules"][0]["kind"] == "baseline"
+    assert any(r["outcome"] == "recovered" for r in report["schedules"])
+    assert report["recovery_latency"]["count"] >= 1
+    assert report["recovery_latency"]["max_s"] < 60.0
+    # Artifact carries the observability payload.
+    assert "hvd_negotiation_rounds_total" in \
+        report["metrics"]["counters"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_16_ranks():
+    report = run_soak(ranks=16, schedules=4, seed=11, n_ops=20,
+                      stall_shutdown_s=2.0)
+    assert report["ok"], report["outcomes"]
+
+
+def test_schedule_generation_deterministic():
+    a = [generate_schedule(5, i, 8)["spec"] for i in range(6)]
+    b = [generate_schedule(5, i, 8)["spec"] for i in range(6)]
+    c = [generate_schedule(6, i, 8)["spec"] for i in range(6)]
+    assert a == b
+    assert a != c
+    assert a[0] == BASELINE_SPEC

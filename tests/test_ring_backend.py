@@ -232,7 +232,8 @@ print("OK")
 def test_ring_failure_demotes_all_ranks_together():
     """One rank failing ring setup must demote EVERY rank to the XLA
     fallback promptly (unanimous two-round agreement) — mixed backends
-    would deadlock at the first collective."""
+    would deadlock at the first collective.  Injection rides the
+    failpoints subsystem (`ring.setup` site, rank predicate)."""
     import time
     t0 = time.monotonic()
     results = run_workers("""
@@ -244,7 +245,7 @@ y = np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
 np.testing.assert_allclose(y, SIZE)
 print("OK")
 """, nproc=3, timeout=240,
-        extra_env={"HOROVOD_RING_TEST_FAIL_RANK": "1"})
+        extra_env={"HOROVOD_FAILPOINTS": "ring.setup=error(rank=1)"})
     assert_all_ok(results)
     # Prompt demotion: the healthy ranks observed the FAIL marker via
     # the agreement rounds instead of waiting out a 60s KV timeout.

@@ -1,0 +1,676 @@
+"""Chaos soak: the real negotiation protocol at 8-16 ranks under
+seeded fault schedules.
+
+What runs is REAL: one rank-0 :class:`CoordinatorServer` plus a full
+:class:`NetworkController` + :class:`BackgroundRuntime` per rank — the
+TCP frame protocol, the response-cache fast path (CH/CB), the inline
+submit path, fusion, stall attribution, and the elastic
+broken-membership machinery all execute exactly as in a pod.  Only two
+things are simulated, where multiprocessing would be too heavy to soak
+at 8-16 ranks in seconds:
+
+* the *processes* — each rank is a thread with its own state/runtime
+  (their metrics merge into the one process registry; the artifact
+  records the merged view);
+* the *data plane* — :class:`SimBackend` routes each fused batch
+  through an in-process exchanger keyed by the LOGICAL identity of
+  every member tensor (name + op index), so a rank that falls out of
+  lockstep produces a detected timeout, never a silently mismatched
+  reduction.
+
+Fault schedules are generated from a master seed and injected through
+``horovod_tpu.common.failpoints`` (sites: runtime.submit/cycle,
+worker.frame_send/frame_recv, coord.frame_recv/broadcast), so every
+run is replayable from its artifact.  Per schedule the harness asserts
+
+* zero hangs — every collective either completes or FAILS within the
+  hang budget (stall shutdown + broken-membership paths must fire);
+* bit-correct results — a collective that reports success must carry
+  exactly the expected reduction;
+* bounded recovery — after a failure, a rebuilt world completes a
+  verification collective within the recovery budget,
+
+and emits a JSON artifact (per-schedule outcome + failpoint trigger
+counts, recovery-latency histogram, metrics snapshot) so robustness
+gets a measured trajectory the way perf does.
+
+Usage::
+
+    python tools/chaos_soak.py --ranks 8 --schedules 5 --seed 0 \
+        --out chaos_soak.json
+"""
+
+import argparse
+import json
+import logging
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from horovod_tpu.common import failpoints, metrics  # noqa: E402
+from horovod_tpu.common.env import Knobs  # noqa: E402
+from horovod_tpu.common.message import (Request, RequestType,  # noqa: E402
+                                        dtype_of)
+from horovod_tpu.common.tensor_queue import TensorTableEntry  # noqa: E402
+
+logger = logging.getLogger("horovod_tpu.chaos")
+
+
+class HangError(RuntimeError):
+    """An operation outlived the hang budget — the one outcome the
+    robustness machinery exists to prevent."""
+
+
+class SimCrash(RuntimeError):
+    """Raised by the harness crash handler on the victim rank's own
+    submitting thread; the harness then severs that rank's control
+    socket, which is what a real process death looks like to the
+    coordinator."""
+
+
+class SimTransportError(RuntimeError):
+    pass
+
+
+class SimArray(np.ndarray):
+    """ndarray carrying the logical identity (name, op index) of the
+    tensor, so the exchanger can pair contributions by MEANING instead
+    of arrival order."""
+    tag = None
+
+
+def tagged(value: np.ndarray, tag) -> SimArray:
+    out = np.ascontiguousarray(value).view(SimArray)
+    out.tag = tag
+    return out
+
+
+class SimExchanger:
+    """In-process eager data plane: rank r's fused batch joins its
+    peers' batch with the same logical key; the reduction runs once in
+    plain numpy.  A slot that never fills (a rank missed its response
+    frame, or died) times out for every waiter — faults become
+    detected errors, never wrong numbers."""
+
+    def __init__(self, size: int, timeout_s: float):
+        self.size = size
+        self.timeout_s = timeout_s
+        self._cond = threading.Condition()
+        self._slots = {}
+
+    def exchange(self, key, rank, payload, combine):
+        deadline = time.monotonic() + self.timeout_s
+        with self._cond:
+            slot = self._slots.get(key)
+            if slot is None:
+                slot = {"vals": {}, "result": None, "error": None,
+                        "taken": 0}
+                self._slots[key] = slot
+            slot["vals"][rank] = payload
+            if len(slot["vals"]) == self.size:
+                try:
+                    slot["result"] = combine(slot["vals"])
+                except Exception as e:  # surface as a transport error
+                    slot["error"] = "combine failed: %r" % e
+                self._cond.notify_all()
+            while slot["result"] is None and slot["error"] is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    missing = sorted(set(range(self.size)) -
+                                     set(slot["vals"]))
+                    slot["error"] = ("exchange %r timed out waiting "
+                                     "for ranks %s" % (key, missing))
+                    self._cond.notify_all()
+                    break
+                self._cond.wait(remaining)
+            err, result = slot["error"], slot["result"]
+            slot["taken"] += 1
+            if slot["taken"] >= self.size:
+                self._slots.pop(key, None)
+        if err is not None:
+            raise SimTransportError(err)
+        return result
+
+
+class SimBackend:
+    """Data-plane stand-in speaking the Backend collective interface
+    the runtime dispatches fused responses into."""
+
+    name = "sim"
+
+    def __init__(self, rank: int, size: int, exchanger: SimExchanger):
+        self.rank = rank
+        self.size = size
+        self.exchanger = exchanger
+        self.stats = {}
+
+    @staticmethod
+    def _key(kind, arrays):
+        return (kind, tuple(getattr(a, "tag", None) for a in arrays))
+
+    def allreduce(self, arrays, reduce_op, prescale, postscale,
+                  ps_ranks=()):
+        assert not ps_ranks, "soak drives world collectives only"
+        payload = [np.asarray(a, np.float64) * prescale for a in arrays]
+
+        def combine(vals):
+            return [np.sum([vals[r][i] for r in vals], axis=0)
+                    for i in range(len(payload))]
+
+        res = self.exchanger.exchange(self._key("AR", arrays),
+                                      self.rank, payload, combine)
+        post = postscale / (self.size if reduce_op == "Average" else 1.0)
+        return [(x * post).astype(np.asarray(a).dtype)
+                for a, x in zip(arrays, res)]
+
+    def broadcast(self, arrays, root_rank, ps_ranks=()):
+        assert not ps_ranks
+
+        def combine(vals):
+            return [np.array(x) for x in vals[root_rank]]
+
+        res = self.exchanger.exchange(self._key("BC", arrays),
+                                      self.rank,
+                                      [np.asarray(a) for a in arrays],
+                                      combine)
+        return [np.array(x) for x in res]
+
+
+class _RankInfoStub:
+    def __init__(self, rank: int, size: int):
+        self.rank = rank
+        self.size = size
+        self.local_rank = rank
+        self.local_size = size
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.launched = True
+
+
+class _StateStub:
+    def __init__(self, rank: int, size: int, knobs: Knobs):
+        self.rank_info = _RankInfoStub(rank, size)
+        self.knobs = knobs
+        self.timeline = None
+        self.backend = None
+        self.init_generation = 0
+        self.parameter_manager = None
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def soak_knobs(stall_shutdown_s: float) -> Knobs:
+    """Robustness machinery tightened to soak time scales: a dropped
+    frame must surface through stall shutdown in seconds, not the
+    production 60s."""
+    return Knobs(
+        cache_capacity=1024,
+        cycle_time_ms=1.0,
+        elastic=True,
+        stall_warning_time_s=max(stall_shutdown_s / 4.0, 0.25),
+        stall_shutdown_time_s=stall_shutdown_s,
+        hierarchical_allreduce=False,
+    )
+
+
+class ChaosWorld:
+    """One incarnation: N in-process ranks over the real control plane
+    (rank 0 hosting the coordinator) and the simulated data plane."""
+
+    def __init__(self, size: int, stall_shutdown_s: float = 4.0,
+                 exchange_timeout_s: float = 8.0):
+        from horovod_tpu.common.runtime import BackgroundRuntime
+
+        self.size = size
+        self.exchanger = SimExchanger(size, exchange_timeout_s)
+        self._saved_env = {}
+        port = _free_port()
+        self._set_env("HOROVOD_CONTROLLER_ADDR", "127.0.0.1:%d" % port)
+        self._set_env("HOROVOD_START_TIMEOUT", "30")
+        self._set_env("HOROVOD_GLOO_RENDEZVOUS_ADDR", None)
+        self._set_env("HOROVOD_GLOO_RENDEZVOUS_PORT", None)
+        knobs = soak_knobs(stall_shutdown_s)
+        self.runtimes = []
+        try:
+            for rank in range(size):  # rank 0 first: it hosts the server
+                st = _StateStub(rank, size, knobs)
+                st.backend = SimBackend(rank, size, self.exchanger)
+                rt = BackgroundRuntime(st)
+                rt.start()
+                self.runtimes.append(rt)
+        except Exception:
+            self.close()
+            raise
+
+    def _set_env(self, key, value):
+        self._saved_env.setdefault(key, os.environ.get(key))
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+
+    def kill_rank(self, rank: int):
+        """Model a process death: stop the runtime and sever its
+        control socket so the coordinator's rank-lost path fires."""
+        rt = self.runtimes[rank]
+        rt._shutdown.set()
+        rt._wake.set()
+        ctrl = rt.controller
+        ctrl._closing = True
+        try:
+            ctrl._sock.close()
+        except OSError:
+            pass
+
+    def submit(self, rank: int, request: Request,
+               entry: TensorTableEntry):
+        self.runtimes[rank].submit(request, entry)
+
+    def collective(self, rank: int, kind: str, name: str, value,
+                   op_index: int, timeout_s: float,
+                   root_rank: int = 0) -> np.ndarray:
+        """Submit one collective on ``rank`` and wait (bounded) for its
+        completion callback."""
+        value = np.asarray(value)
+        box = {}
+        done = threading.Event()
+
+        def cb(ok, result):
+            box["ok"] = ok
+            box["result"] = result
+            done.set()
+
+        rtype = {"allreduce": RequestType.ALLREDUCE,
+                 "broadcast": RequestType.BROADCAST,
+                 "barrier": RequestType.BARRIER}[kind]
+        req = Request(request_rank=rank, request_type=rtype,
+                      tensor_name=name,
+                      tensor_shape=tuple(value.shape),
+                      tensor_type=dtype_of(value),
+                      reduce_op="Sum", root_rank=root_rank)
+        entry = TensorTableEntry(
+            tensor_name=name, tensor=tagged(value, (name, op_index)),
+            callback=cb, root_rank=root_rank)
+        self.submit(rank, req, entry)
+        if not done.wait(timeout_s):
+            raise HangError("%s %r on rank %d exceeded the %ss hang "
+                            "budget" % (kind, name, rank, timeout_s))
+        if not box["ok"]:
+            err = box["result"]
+            raise err if isinstance(err, Exception) else \
+                RuntimeError(str(err))
+        return np.asarray(box["result"]) \
+            if box["result"] is not None else None
+
+    def close(self):
+        # Non-leader ranks sever abruptly (their departure is what the
+        # coordinator drain counts), leader shuts down last.
+        for rank in range(1, len(self.runtimes)):
+            try:
+                self.kill_rank(rank)
+            except Exception:
+                pass
+        if self.runtimes:
+            rt0 = self.runtimes[0]
+            rt0.stop_background()
+            try:
+                rt0.controller.shutdown()
+            except Exception:
+                pass
+        self.runtimes = []
+        for key, value in self._saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        self._saved_env = {}
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+# Inert rule: arms the subsystem (pinning the Python coordinator, the
+# one with injection sites) without ever firing — the control lane
+# every soak starts from.
+BASELINE_SPEC = "chaos.baseline=delay(0s,times=0)"
+
+
+def generate_schedule(master_seed: int, index: int, ranks: int) -> dict:
+    """Schedule ``index`` for a master seed: 1-3 bounded rules over the
+    control-plane and runtime sites.  Every rule carries ``times=`` so
+    injected faults are finite and recovery is always reachable."""
+    if index == 0:
+        return {"index": 0, "spec": BASELINE_SPEC,
+                "seed": master_seed, "kind": "baseline"}
+    rng = random.Random("%d|schedule|%d" % (master_seed, index))
+    menu = [
+        lambda: "runtime.cycle=delay(%dms,p=%.2f,times=%d)"
+                % (rng.randint(2, 25), rng.uniform(0.05, 0.4),
+                   rng.randint(2, 8)),
+        lambda: "runtime.submit=delay(%dms,p=%.2f,times=%d)"
+                % (rng.randint(2, 25), rng.uniform(0.1, 0.5),
+                   rng.randint(2, 8)),
+        lambda: "worker.frame_send=drop(1,after=%d,rank=%d)"
+                % (rng.randint(2, 10), rng.randrange(ranks)),
+        lambda: "worker.frame_recv=drop(1,after=%d,rank=%d)"
+                % (rng.randint(2, 10), rng.randrange(ranks)),
+        lambda: "coord.frame_recv=drop(1,after=%d)"
+                % rng.randint(4, 20),
+        lambda: "coord.broadcast=delay(%dms,p=%.2f,times=%d)"
+                % (rng.randint(2, 15), rng.uniform(0.1, 0.4),
+                   rng.randint(2, 6)),
+        lambda: "runtime.submit=error(injected rank fault,"
+                "after=%d,times=1,rank=%d)"
+                % (rng.randint(2, 10), rng.randrange(ranks)),
+        lambda: "runtime.submit=crash(after=%d,times=1,rank=%d)"
+                % (rng.randint(2, 10), rng.randrange(1, ranks)),
+    ]
+    rules = [rng.choice(menu)() for _ in range(rng.randint(1, 3))]
+    return {"index": index, "spec": ";".join(rules),
+            "seed": master_seed + index, "kind": "fault"}
+
+
+def _expected_allreduce(shape, op_index: int, ranks: int) -> np.ndarray:
+    return np.full(shape,
+                   sum(_rank_value(r, op_index) for r in range(ranks)),
+                   np.float32)
+
+
+def _rank_value(rank: int, op_index: int) -> float:
+    return (rank + 1) * 0.5 + op_index
+
+
+# (name, kind, shape) op templates; names repeat so the response-cache
+# fast path engages from round two onward.
+def _op_list(n_ops: int):
+    names = ["soak.w%d" % i for i in range(5)]
+    ops = []
+    for i in range(n_ops):
+        if i and i % 7 == 0:
+            ops.append(("soak.bcast", "broadcast", (33,)))
+        elif i and i % 11 == 0:
+            ops.append(("soak.barrier", "barrier", ()))
+        else:
+            ops.append((names[i % len(names)], "allreduce", (257,)))
+    return ops
+
+
+def run_schedule(schedule: dict, ranks: int, n_ops: int,
+                 hang_timeout_s: float = 30.0,
+                 stall_shutdown_s: float = 4.0,
+                 recovery_budget_s: float = 60.0) -> dict:
+    """Run one seeded fault schedule; returns its artifact record."""
+    t_start = time.monotonic()
+    failpoints.configure(schedule["spec"], seed=schedule["seed"])
+
+    def crash_handler(site):
+        raise SimCrash("injected crash at %s" % site)
+
+    failpoints.set_crash_handler(crash_handler)
+    ops = _op_list(n_ops)
+    failures = []
+    hangs = []
+    incorrect = []
+    ok_counts = [0] * ranks
+    stop = threading.Event()
+    record_lock = threading.Lock()
+    world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
+                       exchange_timeout_s=2 * stall_shutdown_s)
+
+    def rank_loop(rank: int):
+        for i, (name, kind, shape) in enumerate(ops):
+            if stop.is_set():
+                return
+            try:
+                if kind == "allreduce":
+                    value = np.full(shape, _rank_value(rank, i),
+                                    np.float32)
+                    out = world.collective(rank, kind, name, value, i,
+                                           hang_timeout_s)
+                    expected = _expected_allreduce(shape, i, ranks)
+                    if not np.allclose(out, expected, rtol=1e-5):
+                        with record_lock:
+                            incorrect.append(
+                                {"rank": rank, "op": i, "name": name,
+                                 "got": float(np.ravel(out)[0]),
+                                 "expected":
+                                     float(np.ravel(expected)[0])})
+                        stop.set()
+                        return
+                elif kind == "broadcast":
+                    value = np.full(shape, _rank_value(rank, i),
+                                    np.float32)
+                    out = world.collective(rank, kind, name, value, i,
+                                           hang_timeout_s, root_rank=0)
+                    expected = np.full(shape, _rank_value(0, i),
+                                       np.float32)
+                    if not np.allclose(out, expected):
+                        with record_lock:
+                            incorrect.append(
+                                {"rank": rank, "op": i, "name": name})
+                        stop.set()
+                        return
+                else:
+                    world.collective(rank, "barrier", name,
+                                     np.zeros((), np.float32), i,
+                                     hang_timeout_s)
+                ok_counts[rank] += 1
+            except HangError as e:
+                with record_lock:
+                    hangs.append({"rank": rank, "op": i,
+                                  "error": str(e)})
+                stop.set()
+                return
+            except SimCrash as e:
+                world.kill_rank(rank)
+                with record_lock:
+                    failures.append({"t": time.monotonic(),
+                                     "rank": rank, "op": i,
+                                     "error": repr(e),
+                                     "crashed": True})
+                stop.set()
+                return
+            except Exception as e:
+                with record_lock:
+                    failures.append({"t": time.monotonic(),
+                                     "rank": rank, "op": i,
+                                     "error": repr(e)[:300]})
+                stop.set()
+                return
+
+    threads = [threading.Thread(target=rank_loop, args=(r,),
+                                name="chaos-rank%d" % r, daemon=True)
+               for r in range(ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=n_ops * 2.0 + 2 * hang_timeout_s)
+        if t.is_alive():
+            with record_lock:
+                hangs.append({"rank": t.name, "op": None,
+                              "error": "rank thread never exited"})
+            stop.set()
+    world.close()
+
+    recovery_latency = None
+    recovery_error = None
+    recovery_attempts = 0
+    if failures and not hangs:
+        # Recovery drill: after a failure the job replans.  The fault
+        # schedule stays ARMED — an incarnation may still absorb a
+        # not-yet-spent rule (a real retry loop rides out residual
+        # faults the same way), so up to 3 incarnations may be needed;
+        # every rule is times=-bounded, so the drill converges.  The
+        # recovery latency is failure -> first verified collective,
+        # retries included.
+        t_fail = min(f["t"] for f in failures)
+        for attempt in range(3):
+            recovery_attempts = attempt + 1
+            recovery_error = None
+            try:
+                world2 = ChaosWorld(
+                    ranks, stall_shutdown_s=stall_shutdown_s,
+                    exchange_timeout_s=2 * stall_shutdown_s)
+                try:
+                    verify_threads = []
+                    verify_errs = []
+                    op_index = 10 ** 6 + attempt  # unique logical tag
+
+                    def verify(rank):
+                        try:
+                            out = world2.collective(
+                                rank, "allreduce", "soak.recovery",
+                                np.full((64,), _rank_value(rank, 0),
+                                        np.float32),
+                                op_index, recovery_budget_s)
+                            expected = _expected_allreduce((64,), 0,
+                                                           ranks)
+                            if not np.allclose(out, expected,
+                                               rtol=1e-5):
+                                verify_errs.append(
+                                    "rank %d incorrect" % rank)
+                        except Exception as e:
+                            verify_errs.append(repr(e)[:300])
+
+                    for r in range(ranks):
+                        t = threading.Thread(target=verify, args=(r,),
+                                             daemon=True)
+                        t.start()
+                        verify_threads.append(t)
+                    for t in verify_threads:
+                        t.join(timeout=recovery_budget_s + 10)
+                        if t.is_alive():
+                            verify_errs.append("verification hang")
+                    if verify_errs:
+                        recovery_error = verify_errs[0]
+                    else:
+                        recovery_latency = time.monotonic() - t_fail
+                finally:
+                    world2.close()
+            except Exception as e:
+                recovery_error = repr(e)[:300]
+            if recovery_latency is not None:
+                break
+
+    triggers = failpoints.snapshot()
+    failpoints.reset()
+    failpoints.set_crash_handler(None)
+
+    if hangs:
+        outcome = "hang"
+    elif incorrect:
+        outcome = "incorrect"
+    elif failures and recovery_error:
+        outcome = "recovery_failed"
+    elif failures:
+        outcome = "recovered"
+    else:
+        outcome = "ok"
+    return {
+        "index": schedule["index"],
+        "kind": schedule["kind"],
+        "spec": schedule["spec"],
+        "seed": schedule["seed"],
+        "outcome": outcome,
+        "ops_per_rank": n_ops,
+        "ops_ok": ok_counts,
+        "failures": [{k: (round(v, 3) if k == "t" else v)
+                      for k, v in f.items() if k != "t"}
+                     for f in failures],
+        "hangs": hangs,
+        "incorrect": incorrect,
+        "recovery_latency_s": (round(recovery_latency, 3)
+                               if recovery_latency is not None else None),
+        "recovery_attempts": recovery_attempts,
+        "recovery_error": recovery_error,
+        "failpoint_triggers": triggers,
+        "elapsed_s": round(time.monotonic() - t_start, 3),
+    }
+
+
+def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
+             n_ops: int = 30, hang_timeout_s: float = 30.0,
+             stall_shutdown_s: float = 4.0) -> dict:
+    """Run ``schedules`` seeded schedules; returns the full artifact
+    dict.  ``ok`` is True iff no schedule hung, mis-reduced, or failed
+    to recover."""
+    t0 = time.monotonic()
+    records = []
+    for i in range(schedules):
+        schedule = generate_schedule(seed, i, ranks)
+        logger.info("chaos schedule %d/%d: %s", i + 1, schedules,
+                    schedule["spec"])
+        records.append(run_schedule(
+            schedule, ranks, n_ops, hang_timeout_s=hang_timeout_s,
+            stall_shutdown_s=stall_shutdown_s))
+    latencies = [r["recovery_latency_s"] for r in records
+                 if r["recovery_latency_s"] is not None]
+    hist = metrics.Histogram("recovery_latency",
+                             bounds=metrics.log_bounds(0.25, 2.0, 12))
+    for lat in latencies:
+        hist.observe(lat)
+    bad = [r for r in records
+           if r["outcome"] in ("hang", "incorrect", "recovery_failed")]
+    return {
+        "ranks": ranks,
+        "seed": seed,
+        "schedules": records,
+        "recovery_latency": {
+            "count": len(latencies),
+            "max_s": max(latencies) if latencies else None,
+            "histogram": hist.snapshot() or None,
+        },
+        "outcomes": {o: sum(1 for r in records if r["outcome"] == o)
+                     for o in sorted({r["outcome"] for r in records})},
+        "metrics": metrics.snapshot(),
+        "ok": not bad,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--ranks", type=int, default=8)
+    parser.add_argument("--schedules", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ops", type=int, default=30)
+    parser.add_argument("--hang-timeout", type=float, default=30.0)
+    parser.add_argument("--stall-shutdown", type=float, default=4.0)
+    parser.add_argument("--out", default=None,
+                        help="write the JSON artifact here")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING)
+    report = run_soak(ranks=args.ranks, schedules=args.schedules,
+                      seed=args.seed, n_ops=args.ops,
+                      hang_timeout_s=args.hang_timeout,
+                      stall_shutdown_s=args.stall_shutdown)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    summary = {k: report[k] for k in ("ranks", "seed", "outcomes",
+                                      "recovery_latency", "ok",
+                                      "elapsed_s")}
+    print("CHAOSJSON " + json.dumps(summary))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
