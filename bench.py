@@ -416,6 +416,243 @@ def bench_keras_jax(args, smoke: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Async durable-checkpoint overhead (vs no-checkpoint baseline)
+# ---------------------------------------------------------------------------
+
+def bench_checkpoint(args, smoke: bool) -> dict:
+    """Async-checkpoint overhead on the CPU smoke trainer: the smoke
+    ResNet train step timed bare vs with durable async commits
+    (horovod_tpu.checkpoint pipeline — host capture on the step path;
+    shard write, fsync, two-phase manifest publish, retention GC on
+    the writer thread), plus restore latency for the result.
+
+    The commit cadence is DERIVED the CheckFreq way: one measured
+    synchronous save fixes the per-checkpoint cost, and the cadence is
+    chosen so the amortized cost targets < 5 % of the baseline step
+    time (on a 1-core rig the persistence CPU cannot hide behind
+    training, so cadence is the only lever — exactly the CheckFreq
+    argument; the artifact records the cadence, the blocking capture
+    cost, and the wall overhead separately)."""
+    import math
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from horovod_tpu.checkpoint import CheckpointManager
+    from horovod_tpu.common import metrics as _metrics
+
+    if smoke:
+        batch_size, img, iters, warmup = args.batch_size or 8, 32, 10, 2
+    else:
+        batch_size = args.batch_size or 16
+        img, iters, warmup = 224, max(args.num_iters // 2, 10), \
+            args.warmup
+    (train_step, params, batch_stats, opt_state, x,
+     labels) = build_resnet_train_step(batch_size, img, 10, smoke=True)
+
+    def step(c):
+        return train_step(c[0], c[1], c[2], x, labels)
+
+    def fresh_carry():
+        # train_step donates its carry; each timed phase needs its own
+        # copy of the initial state or the second phase would feed
+        # already-donated buffers.
+        return jax.tree_util.tree_map(
+            lambda a: a.copy(), (params, batch_stats, opt_state)
+        ) + (None,)
+
+    def snapshot_items(c):
+        # np.array (not asarray): a forced host copy — a zero-copy
+        # view would alias a buffer the next step donates away while
+        # the writer thread is still serializing it.
+        leaves = jax.tree_util.tree_leaves((c[0], c[1], c[2]))
+        return {"leaf/%05d" % i: np.array(l)
+                for i, l in enumerate(leaves)}
+
+    dt_base, noise_base = _timed_loop(step, fresh_carry(), warmup,
+                                      iters, lambda c: float(c[3]))
+    step_s = dt_base / iters
+
+    ckpt_dir = tempfile.mkdtemp(prefix="hvd-bench-ckpt-")
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    try:
+        # One synchronous probe save fixes the per-checkpoint cost,
+        # from which the cadence that amortizes to the 5% target
+        # falls out (CheckFreq's tuning rule).  The measured loop runs
+        # at a CAPPED cadence so the smoke actually contains several
+        # saves — a deliberate over-stress on rigs where the derived
+        # cadence is long; `amortized_overhead_pct` (below) is the
+        # number the target applies to.
+        t0 = time.perf_counter()
+        mgr.save(0, snapshot_items(fresh_carry()), timeout=120)
+        save_probe_s = time.perf_counter() - t0
+        derived_cadence = max(1, int(math.ceil(
+            save_probe_s / (0.05 * step_s))))
+        cadence = min(derived_cadence, 25)
+        iters_ckpt = max(iters, min(2 * cadence, 50))
+
+        counter = {"step": 0}
+
+        def step_ckpt(c):
+            c = train_step(c[0], c[1], c[2], x, labels)
+            counter["step"] += 1
+            if counter["step"] % cadence == 0:
+                # Host-side capture on the training path; everything
+                # after (serialize/fsync/commit) rides the writer.
+                mgr.save_async(counter["step"], snapshot_items(c))
+            return c
+
+        dt_ckpt, noise_ckpt = _timed_loop(
+            step_ckpt, fresh_carry(), warmup, iters_ckpt,
+            lambda c: float(c[3]))
+        if not mgr.wait(timeout=120):
+            return {"error": "checkpoint writer never drained"}
+        saves = counter["step"] // cadence
+
+        t0 = time.perf_counter()
+        restored_step, items = mgr.restore_latest()
+        restore_s = time.perf_counter() - t0
+        flat = snapshot_items(fresh_carry())   # shape/coverage check
+        nbytes = sum(v.nbytes for v in items.values())
+
+        snap = _metrics.snapshot()
+        save_hist = snap.get("histograms", {}).get(
+            "hvd_ckpt_save_seconds", {})
+        total = save_hist.get("phase=total", {})
+        capture = save_hist.get("phase=capture", {})
+        overhead_pct = (dt_ckpt / iters_ckpt - step_s) / step_s * 100.0
+        capture_pct = (capture["sum"] / dt_ckpt * 100.0) \
+            if capture.get("count") else None
+        # Per-save cost for the cadence rule: the writer's own busy
+        # time (serialize+write+commit, measured in-loop) — on 1 core
+        # a zero-overlap UPPER bound on what a save can add to the
+        # run, and far more stable than the wall delta on a noisy rig
+        # (the wall-measured `overhead_pct` stays as the empirical
+        # cross-check).  `cadence_for_target` is the
+        # HOROVOD_CHECKPOINT_EVERY an operator sets to bound overhead
+        # at 5% even with zero overlap; `amortized_overhead_pct` is
+        # the bound actually achieved at that cadence.
+        save_cost_s = (total["sum"] / total["count"]) \
+            if total.get("count") else save_probe_s
+        cadence_for_target = max(1, int(math.ceil(
+            save_cost_s / (0.05 * step_s))))
+        amortized_pct = save_cost_s / (cadence_for_target *
+                                       step_s) * 100.0
+        return {
+            "steps": iters_ckpt,
+            "cores": os.cpu_count(),
+            "baseline_steps_per_sec": round(iters / dt_base, 2),
+            "ckpt_steps_per_sec": round(iters_ckpt / dt_ckpt, 2),
+            "cadence_steps": cadence,
+            "derived_cadence_steps": derived_cadence,
+            "saves": saves,
+            "overhead_pct": round(overhead_pct, 1),
+            "save_cost_ms": round(save_cost_s * 1e3, 1),
+            "cadence_for_target": cadence_for_target,
+            "amortized_overhead_pct": round(amortized_pct, 2),
+            "overhead_target_pct": 5.0,
+            # What the training thread pays synchronously (the
+            # CheckFreq decoupling claim, cadence-independent).
+            "capture_overhead_pct": round(capture_pct, 3)
+            if capture_pct is not None else None,
+            "spread_pct": max(noise_base["spread_pct"],
+                              noise_ckpt["spread_pct"]),
+            "checkpoint_bytes": nbytes,
+            "items": len(items),
+            "coverage_ok": set(items) == set(flat),
+            "restored_step": restored_step,
+            "restore_ms": round(restore_s * 1e3, 2),
+            "save_ms": {
+                "probe_sync": round(save_probe_s * 1e3, 2),
+                "mean_total": round(
+                    total["sum"] / total["count"] * 1e3, 2)
+                if total.get("count") else None,
+                "max_total": round((total.get("max") or 0) * 1e3, 2),
+                "mean_capture": round(
+                    capture["sum"] / capture["count"] * 1e3, 3)
+                if capture.get("count") else None,
+            },
+            # The latency histograms ride the bench artifact next to
+            # the rest of the metrics snapshot.
+            "metrics": {
+                "hvd_ckpt_save_seconds": save_hist,
+                "hvd_ckpt_restore_seconds": snap.get(
+                    "histograms", {}).get("hvd_ckpt_restore_seconds"),
+                "hvd_ckpt_commits_total": snap.get(
+                    "counters", {}).get("hvd_ckpt_commits_total"),
+                "hvd_ckpt_bytes_total": snap.get(
+                    "counters", {}).get("hvd_ckpt_bytes_total"),
+            },
+        }
+    finally:
+        mgr.close(timeout=10)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def check_ckpt_regression(out: dict, repo_dir: str):
+    """Same treatment as the smoke headline: warn (stderr + artifact
+    field) when the checkpoint cost regressed vs the prior round's
+    artifact beyond the run's own noise, when the blocking capture
+    path stops being negligible, or when the amortized overhead at
+    the derived cadence misses the 5 % target."""
+    import glob
+    import re
+    cur = out.get("checkpoint_smoke") or {}
+    if not cur or "error" in cur:
+        return
+    amortized = cur.get("amortized_overhead_pct")
+    if amortized is not None and \
+            amortized > cur.get("overhead_target_pct", 5.0):
+        print("WARNING: async-checkpoint amortized overhead %.1f%% "
+              "exceeds the 5%% target on the CPU smoke trainer"
+              % amortized, file=sys.stderr)
+    capture = cur.get("capture_overhead_pct")
+    if capture is not None and capture > 1.0:
+        print("WARNING: checkpoint capture (the training-blocking "
+              "phase) cost %.2f%% of the run — the async decoupling "
+              "is broken" % capture, file=sys.stderr)
+    cur_cost = cur.get("save_cost_ms")
+    if cur_cost is None:
+        return
+    prior = None
+    for path in reversed(sorted(glob.glob(
+            os.path.join(repo_dir, "BENCH_r*.json")))):
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        m = re.search(
+            r'\\?"checkpoint_smoke\\?":\s*\{.*?"save_cost_ms'
+            r'":\s*(-?[0-9.]+)', raw, re.S)
+        if m and float(m.group(1)) > 0:
+            prior = {"save_cost_ms": float(m.group(1)),
+                     "source": os.path.basename(path)}
+            break
+    if prior is None:
+        return
+    tol_pct = max(float(cur.get("spread_pct") or 0.0), 10.0)
+    delta_pct = (cur_cost - prior["save_cost_ms"]) \
+        / prior["save_cost_ms"] * 100.0
+    cur["ckpt_vs_prior"] = {
+        "prior_save_cost_ms": prior["save_cost_ms"],
+        "prior_source": prior["source"],
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": round(tol_pct, 1),
+        "regressed": delta_pct > tol_pct,
+    }
+    if cur["ckpt_vs_prior"]["regressed"]:
+        print("WARNING: per-checkpoint cost regressed %.1f%% vs %s "
+              "(%.0f ms -> %.0f ms per save), beyond the %.1f%% "
+              "noise band"
+              % (delta_pct, prior["source"],
+                 prior["save_cost_ms"], cur_cost, tol_pct),
+              file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
 # Eager allreduce micro-benchmark (2 real processes, real control plane)
 # ---------------------------------------------------------------------------
 
@@ -805,7 +1042,7 @@ def main():
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
-                        "collectives"],
+                        "collectives", "checkpoint"],
                    default=None)
     args = p.parse_args()
 
@@ -847,7 +1084,7 @@ def main():
             out["tpu_probe"] = probe_diag
 
     run = {args.only} if args.only else {"resnet", "bert", "keras",
-                                     "collectives"}
+                                     "collectives", "checkpoint"}
 
     resnet = {}
     if "resnet" in run:
@@ -868,6 +1105,12 @@ def main():
             else "keras_mnist_jax_smoke"
         try:
             out[key] = bench_keras_jax(args, args.smoke)
+        except Exception as e:
+            out[key] = {"error": repr(e)[:300]}
+    if "checkpoint" in run:
+        key = "checkpoint" if not args.smoke else "checkpoint_smoke"
+        try:
+            out[key] = bench_checkpoint(args, args.smoke)
         except Exception as e:
             out[key] = {"error": repr(e)[:300]}
     if "collectives" in run:
@@ -895,6 +1138,8 @@ def main():
 
     if args.smoke:
         check_smoke_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+        check_ckpt_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
     img_sec = resnet.get("images_per_sec", 0.0)
     out.update({

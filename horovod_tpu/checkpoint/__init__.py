@@ -1,0 +1,65 @@
+"""Durable sharded checkpointing for elastic training state.
+
+The elastic machinery (``common/elastic.py``) survives rank loss by
+rebuilding from ranks that are still alive — its ``State.save/restore/
+commit`` snapshots live in host memory.  A whole-job preemption (the
+normal failure mode for TPU slices) loses everything since step 0.
+This package is the missing durability layer: per-rank-sharded disk
+checkpoints with an async write pipeline, atomic per-shard publish, a
+coordinator-arbitrated global commit, and a preemption-safe restore
+path that re-shards when the world size changed.
+
+Design (shaped by CheckFreq, FAST '21, and Check-N-Run, NSDI '22 —
+see PAPERS.md):
+
+* **Decoupled snapshot pipeline** — ``CheckpointManager.save_async``
+  returns after capturing a host-side reference snapshot (the elastic
+  ``State`` already holds host copies); serialization, fsync, and the
+  commit protocol run on a writer thread overlapped with training.
+  The pipeline is double-buffered: at most one save in flight and one
+  queued; a newer queued save supersedes an older still-queued one.
+* **Sharded, atomic writes** — each rank writes only the items it
+  owns (a deterministic partition of the state's flat item dict) to a
+  temp file, fsyncs, then renames.  A shard is self-checking (magic +
+  length + sha256 trailer) and the manifest re-records every shard's
+  checksum.
+* **Coordinator-arbitrated commit** — a checkpoint step becomes
+  visible only when every rank's shard landed: ranks mark *prepared*
+  through a :class:`~.coordinator.CommitCoordinator` (in-process for
+  tests/threads, rendezvous-KV backed for real jobs); rank 0 gathers
+  all marks and only then atomically publishes ``MANIFEST.json``.
+  The manifest is the single durable commit record — no torn
+  checkpoints, all-or-nothing.
+* **Elastic restore** — ``restore_latest`` walks steps newest-first,
+  verifies checksums, and falls back to the previous valid step on
+  corruption.  Restoring at world size M from a checkpoint written at
+  N reads the manifest's layout and redistributes the items — resize
+  N→M→N round-trips exactly.
+* **Failpoints + metrics** — every stage carries a failpoint site
+  (``ckpt.serialize`` / ``ckpt.shard_write`` / ``ckpt.shard_write.torn``
+  / ``ckpt.prepare`` / ``ckpt.manifest_publish`` / ``ckpt.restore``)
+  and the registry records save/restore latency histograms, bytes, and
+  commit outcomes, so the chaos soak can kill ranks mid-write and
+  assert recovery (tools/chaos_soak.py ``run_checkpoint_drill``).
+
+See docs/checkpointing.md for the on-disk format and commit protocol.
+"""
+
+from .coordinator import (CommitCoordinator, KVCommitCoordinator,
+                          LocalCommitCoordinator)
+from .elastic import DurableCheckpointer
+from .manager import (CheckpointError, CheckpointManager,
+                      CheckpointNotFoundError)
+from .manifest import (MANIFEST_NAME, Manifest, list_step_dirs, read_manifest,
+                       step_dir)
+from .preemption import install_preemption_hook
+from .shard_io import CheckpointCorruptError
+
+__all__ = [
+    "CheckpointManager", "CheckpointError", "CheckpointNotFoundError",
+    "CheckpointCorruptError", "CommitCoordinator",
+    "LocalCommitCoordinator", "KVCommitCoordinator",
+    "DurableCheckpointer", "install_preemption_hook",
+    "Manifest", "MANIFEST_NAME", "read_manifest", "step_dir",
+    "list_step_dirs",
+]

@@ -1,0 +1,183 @@
+"""Commit coordination: the two-phase mark that makes a checkpoint
+step all-or-nothing across ranks.
+
+Phase 1 (*prepare*): after a rank's shard file has landed (written,
+fsynced, renamed), the rank publishes a prepare mark carrying the
+shard's checksum and item list.
+
+Phase 2 (*commit*): the arbiter (rank 0's writer thread) gathers every
+rank's mark for the step; only with all of them in hand does it write
+the manifest (the durable commit bit) and publish the committed-step
+mark.  A rank that died mid-write never marks, the gather times out,
+and the step is abandoned — shards without a manifest are invisible
+to restore and reaped by GC.
+
+Two transports:
+
+* :class:`LocalCommitCoordinator` — in-process, for single-process
+  jobs, unit tests, and the thread-per-rank chaos harness.
+* :class:`KVCommitCoordinator` — marks ride the elastic rendezvous KV
+  store (``runner/http_server.py``), the same control lane rank
+  assignment uses, so real multi-process jobs need no new service.
+"""
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common import failpoints as _fp
+
+logger = logging.getLogger("horovod_tpu.checkpoint")
+
+SCOPE = "ckpt"
+KEY_LATEST = "latest"
+
+
+class CommitCoordinator:
+    """Interface; see module docstring for the protocol."""
+
+    def prepare(self, step: int, rank: int, entry: dict):
+        """Publish rank's phase-1 mark for ``step`` (shard landed)."""
+        raise NotImplementedError
+
+    def gather(self, step: int, world_size: int, timeout: float
+               ) -> Optional[List[dict]]:
+        """Arbiter: block (bounded) until every rank's mark for
+        ``step`` is present; returns them ordered by rank, or None on
+        timeout (the step must then be abandoned, never committed)."""
+        raise NotImplementedError
+
+    def mark_committed(self, step: int):
+        """Arbiter: record ``step`` as the newest committed one (the
+        manifest is already on disk — this is the fast-path signal for
+        peers and the elastic driver, not the durable truth)."""
+        raise NotImplementedError
+
+    def committed_step(self) -> Optional[int]:
+        """Newest step the arbiter marked committed, or None."""
+        raise NotImplementedError
+
+
+class LocalCommitCoordinator(CommitCoordinator):
+    """In-process coordination (threads standing in for ranks)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._marks: Dict[int, Dict[int, dict]] = {}
+        self._committed: Optional[int] = None
+
+    def prepare(self, step: int, rank: int, entry: dict):
+        with self._cond:
+            self._marks.setdefault(step, {})[rank] = dict(entry)
+            self._cond.notify_all()
+
+    def gather(self, step: int, world_size: int, timeout: float
+               ) -> Optional[List[dict]]:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                marks = self._marks.get(step, {})
+                if len(marks) >= world_size:
+                    return [marks[r] for r in sorted(marks)][:world_size]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        "ckpt commit gather timed out at step %d: "
+                        "have ranks %s of %d", step,
+                        sorted(marks), world_size)
+                    return None
+                self._cond.wait(remaining)
+
+    def mark_committed(self, step: int):
+        with self._cond:
+            if self._committed is None or step > self._committed:
+                self._committed = step
+            self._marks.pop(step, None)
+            self._cond.notify_all()
+
+    def committed_step(self) -> Optional[int]:
+        with self._cond:
+            return self._committed
+
+
+class KVCommitCoordinator(CommitCoordinator):
+    """Marks in the rendezvous KV store under the ``ckpt`` scope::
+
+        PUT ckpt/prepare-<step>-<rank>   (phase 1, per rank)
+        PUT ckpt/latest                  (phase 2, arbiter)
+
+    ``client`` is a :class:`runner.http_server.RendezvousClient` (or
+    anything with its put/get signature).  Transient HTTP failures ride
+    the poll loop; the failpoint site ``ckpt.prepare`` injects them
+    deliberately (drop = lost mark → commit times out)."""
+
+    def __init__(self, client, poll_interval_s: float = 0.1):
+        self._client = client
+        self._poll = poll_interval_s
+
+    @staticmethod
+    def _prep_key(step: int, rank: int) -> str:
+        return "prepare-%d-%d" % (step, rank)
+
+    def prepare(self, step: int, rank: int, entry: dict):
+        if _fp.ENABLED and _fp.maybe_fail("ckpt.prepare",
+                                          rank=rank) == "drop":
+            # A lost prepare mark: the shard landed but the arbiter
+            # never learns — the step must time out uncommitted.
+            logger.warning("failpoint ckpt.prepare: dropping prepare "
+                           "mark step=%d rank=%d", step, rank)
+            return
+        self._client.put(SCOPE, self._prep_key(step, rank),
+                         json.dumps(entry).encode())
+
+    def gather(self, step: int, world_size: int, timeout: float
+               ) -> Optional[List[dict]]:
+        deadline = time.monotonic() + timeout
+        marks: Dict[int, dict] = {}
+        while True:
+            for rank in range(world_size):
+                if rank in marks:
+                    continue
+                try:
+                    raw = self._client.get(SCOPE,
+                                           self._prep_key(step, rank))
+                except OSError:
+                    raw = None  # transient; retry next poll
+                if raw is not None:
+                    try:
+                        marks[rank] = json.loads(raw.decode())
+                    except ValueError:
+                        logger.warning("ckpt: malformed prepare mark "
+                                       "for step %d rank %d", step,
+                                       rank)
+            if len(marks) >= world_size:
+                return [marks[r] for r in sorted(marks)]
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    "ckpt commit gather timed out at step %d: have "
+                    "ranks %s of %d", step, sorted(marks), world_size)
+                return None
+            time.sleep(self._poll)
+
+    def mark_committed(self, step: int):
+        try:
+            self._client.put(SCOPE, KEY_LATEST, str(step).encode())
+        except OSError:
+            # Non-fatal: the manifest on disk is the durable truth;
+            # the KV mark only accelerates peers/driver discovery.
+            logger.warning("ckpt: failed to publish committed step %d "
+                           "to the rendezvous KV", step)
+
+    def committed_step(self) -> Optional[int]:
+        try:
+            raw = self._client.get(SCOPE, KEY_LATEST)
+        except OSError:
+            return None
+        if raw is None:
+            return None
+        try:
+            return int(raw.decode())
+        except ValueError:
+            return None

@@ -1,0 +1,376 @@
+"""CheckpointManager: the async sharded save/restore pipeline.
+
+Save path (per rank)::
+
+    save_async(step, items)            # returns immediately
+      └─ writer thread:
+           serialize own shard  ──  ckpt.serialize
+           write + fsync + rename    ckpt.shard_write[.torn]
+           prepare mark              ckpt.prepare
+           (rank 0 only) gather all marks → write MANIFEST → GC
+                                     ckpt.manifest_publish
+
+``save_async`` captures only a shallow dict of host-side references —
+the elastic ``State.save()`` that precedes it already copied device
+values to host, and its snapshots are rebound (never mutated in place)
+on the next save, so the writer thread serializes a stable view while
+training runs ahead.  The pipeline is double-buffered: one save in
+flight, one queued; queuing a third supersedes the queued one (its
+outcome is recorded as ``superseded``).
+
+Restore path: newest committed step first, full checksum verification,
+fall back to the previous committed step when anything fails
+validation.  Restoring at world size M from an N-way checkpoint reads
+the manifest layout and merges the N shards — the caller re-shards by
+construction since the item dict is world-shape-independent.
+"""
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common import failpoints as _fp
+from ..common import metrics
+from . import manifest as _mf
+from . import shard_io
+from .coordinator import CommitCoordinator, LocalCommitCoordinator
+
+logger = logging.getLogger("horovod_tpu.checkpoint")
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """No committed-and-valid checkpoint exists under the directory."""
+
+
+_SAVE_SECONDS = metrics.histogram(
+    "hvd_ckpt_save_seconds",
+    "Checkpoint save latency by phase (capture is the part training "
+    "blocks on; write/commit overlap training)")
+_RESTORE_SECONDS = metrics.histogram(
+    "hvd_ckpt_restore_seconds", "Checkpoint restore latency by phase")
+_BYTES = metrics.counter(
+    "hvd_ckpt_bytes_total", "Checkpoint bytes by direction")
+_COMMITS = metrics.counter(
+    "hvd_ckpt_commits_total",
+    "Checkpoint save outcomes by kind "
+    "(committed/prepared/failed/superseded)")
+_FALLBACKS = metrics.counter(
+    "hvd_ckpt_restore_fallbacks_total",
+    "Restores that skipped an invalid newest checkpoint")
+_GC_REMOVED = metrics.counter(
+    "hvd_ckpt_gc_removed_total", "Checkpoint step dirs removed by GC")
+_PENDING = metrics.gauge(
+    "hvd_ckpt_pending_saves", "Snapshots captured but not yet durable")
+
+
+class _Pending:
+    __slots__ = ("step", "items", "done", "outcome", "error")
+
+    def __init__(self, step: int, items: Dict[str, object]):
+        self.step = step
+        self.items = items
+        self.done = threading.Event()
+        self.outcome: Optional[str] = None
+        self.error: Optional[BaseException] = None
+
+
+class CheckpointManager:
+    """Durable sharded checkpoints under one root directory.
+
+    One instance per (rank, incarnation); rebuild it after an elastic
+    resize (cheap — the on-disk state is the only state that matters).
+    ``rank``/``world_size`` describe the SAVING layout; restore works
+    regardless of the layout a checkpoint was written with.
+
+    The directory must be shared storage when ``world_size > 1``
+    (same-host path, NFS, or a FUSE-mounted bucket): rank 0 validates
+    peers' shards only through their prepare-mark checksums, and
+    restore reads every shard.
+    """
+
+    def __init__(self, directory: str, rank: int = 0,
+                 world_size: int = 1,
+                 coordinator: Optional[CommitCoordinator] = None,
+                 keep: Optional[int] = 3,
+                 commit_timeout_s: float = 60.0):
+        if world_size > 1 and coordinator is None:
+            raise ValueError(
+                "multi-rank checkpointing needs a shared "
+                "CommitCoordinator (Local for threads, KV for "
+                "processes)")
+        self.directory = str(directory)
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.keep = keep
+        self.commit_timeout_s = commit_timeout_s
+        self.coordinator = coordinator or LocalCommitCoordinator()
+        self._lock = threading.Lock()
+        self._queued: Optional[_Pending] = None
+        self._inflight: Optional[_Pending] = None
+        self._wake = threading.Event()
+        self._closed = False
+        self._writer: Optional[threading.Thread] = None
+        self._outcomes: Dict[int, str] = {}
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    # save pipeline
+    # ------------------------------------------------------------------
+    def save_async(self, step: int, items: Dict[str, object]):
+        """Enqueue a snapshot for durable write; returns after the
+        host-side capture (a shallow reference copy — see module
+        docstring for why that is a stable view)."""
+        t0 = time.perf_counter()
+        if self._closed:
+            raise CheckpointError("CheckpointManager is closed")
+        if not isinstance(items, dict) or not items:
+            raise ValueError("checkpoint items must be a non-empty "
+                             "dict of name -> object")
+        pending = _Pending(int(step), dict(items))
+        with self._lock:
+            superseded = self._queued
+            self._queued = pending
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop,
+                    name="hvd-ckpt-writer-r%d" % self.rank, daemon=True)
+                self._writer.start()
+        if superseded is not None:
+            superseded.outcome = "superseded"
+            superseded.done.set()
+            self._record_outcome(superseded)
+        _PENDING.inc()
+        self._wake.set()
+        _SAVE_SECONDS.observe(time.perf_counter() - t0, phase="capture")
+
+    def save(self, step: int, items: Dict[str, object],
+             timeout: Optional[float] = None) -> str:
+        """Synchronous save; returns the outcome (``committed`` on the
+        arbiter, ``prepared`` on other ranks).  Raises on failure."""
+        self.save_async(step, items)
+        if not self.wait(timeout):
+            raise CheckpointError("checkpoint save timed out")
+        outcome = self._outcomes.get(int(step))
+        if outcome not in ("committed", "prepared"):
+            raise CheckpointError(
+                "checkpoint step %d not durable: %s"
+                % (step, outcome or "unknown"))
+        return outcome
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued save reached a terminal outcome;
+        False when ``timeout`` expired first."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pending = self._inflight or self._queued
+            if pending is None:
+                return True
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if not pending.done.wait(remaining):
+                return False
+
+    def outcome(self, step: int) -> Optional[str]:
+        with self._lock:
+            return self._outcomes.get(int(step))
+
+    def close(self, timeout: float = 30.0):
+        """Drain pending saves (bounded) and stop the writer."""
+        self.wait(timeout)
+        self._closed = True
+        self._wake.set()
+        w = self._writer
+        if w is not None:
+            w.join(timeout=5.0)
+
+    def abort(self):
+        """Emergency teardown: drop the queued snapshot (outcome
+        ``aborted``) and refuse further saves.  The in-flight write,
+        if any, runs to completion — it is atomic either way.  Used on
+        fatal errors and by harnesses modeling a process death."""
+        with self._lock:
+            self._closed = True
+            dropped, self._queued = self._queued, None
+        self._wake.set()
+        if dropped is not None:
+            dropped.outcome = "aborted"
+            dropped.done.set()
+            self._record_outcome(dropped)
+
+    def _record_outcome(self, pending: "_Pending"):
+        with self._lock:
+            self._outcomes[pending.step] = pending.outcome
+        _COMMITS.inc(1, outcome=pending.outcome)
+        _PENDING.dec()
+
+    def _writer_loop(self):
+        while True:
+            self._wake.wait(0.5)
+            with self._lock:
+                if self._queued is None:
+                    self._wake.clear()
+                    if self._closed:
+                        return
+                    continue
+                pending = self._queued
+                self._queued = None
+                self._inflight = pending
+            try:
+                pending.outcome = self._write_one(pending)
+            except _fp.FailpointError as e:
+                pending.outcome = "failed"
+                pending.error = e
+                logger.warning("ckpt save step %d failed (injected): "
+                               "%s", pending.step, e)
+            except Exception as e:
+                pending.outcome = "failed"
+                pending.error = e
+                logger.exception("ckpt save step %d failed",
+                                 pending.step)
+            finally:
+                with self._lock:
+                    self._inflight = None
+                pending.done.set()
+                self._record_outcome(pending)
+
+    def _write_one(self, pending: "_Pending") -> str:
+        t_start = time.perf_counter()
+        step, items = pending.step, pending.items
+        layout = _mf.assign_shards(list(items), self.world_size)
+        own = sorted(n for n, r in layout.items() if r == self.rank)
+        sdir = _mf.step_dir(self.directory, step)
+        os.makedirs(sdir, exist_ok=True)
+
+        payload = shard_io.serialize_items({n: items[n] for n in own},
+                                           rank=self.rank)
+        _SAVE_SECONDS.observe(time.perf_counter() - t_start,
+                              phase="serialize")
+
+        t_w = time.perf_counter()
+        fname = _mf.shard_name(self.rank, self.world_size)
+        digest, nbytes = shard_io.write_shard(
+            os.path.join(sdir, fname), payload, rank=self.rank)
+        _BYTES.inc(nbytes, direction="write")
+        _SAVE_SECONDS.observe(time.perf_counter() - t_w, phase="write")
+
+        entry = {"rank": self.rank, "filename": fname,
+                 "sha256": digest, "nbytes": nbytes, "items": own}
+        self.coordinator.prepare(step, self.rank, entry)
+
+        if self.rank != 0:
+            _SAVE_SECONDS.observe(time.perf_counter() - t_start,
+                                  phase="total")
+            return "prepared"
+
+        t_c = time.perf_counter()
+        marks = self.coordinator.gather(step, self.world_size,
+                                        self.commit_timeout_s)
+        if marks is None:
+            # A rank died (or its mark was lost) mid-checkpoint: the
+            # step is abandoned — no manifest, hence invisible.
+            _SAVE_SECONDS.observe(time.perf_counter() - t_c,
+                                  phase="commit")
+            return "failed"
+        man = _mf.Manifest(step=step, world_size=self.world_size,
+                           shards=marks, layout=layout)
+        _mf.write_manifest(sdir, man, rank=self.rank)
+        self.coordinator.mark_committed(step)
+        _SAVE_SECONDS.observe(time.perf_counter() - t_c, phase="commit")
+        _SAVE_SECONDS.observe(time.perf_counter() - t_start,
+                              phase="total")
+        self.gc()
+        logger.info("ckpt: step %d committed (%d ranks, %d items)",
+                    step, self.world_size, len(items))
+        return "committed"
+
+    # ------------------------------------------------------------------
+    # restore
+    # ------------------------------------------------------------------
+    def committed_steps(self) -> List[int]:
+        return _mf.committed_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int) -> Dict[str, object]:
+        """Restore one step, verifying every shard against the
+        manifest.  Raises :class:`CheckpointCorruptError` /
+        ``ValueError`` / ``OSError`` when the step fails validation."""
+        t0 = time.perf_counter()
+        sdir = _mf.step_dir(self.directory, step)
+        man = _mf.read_manifest(sdir)
+        items: Dict[str, object] = {}
+        nbytes = 0
+        for entry in man.shards:
+            shard = shard_io.read_shard(
+                os.path.join(sdir, entry["filename"]),
+                expect_sha256=entry.get("sha256"))
+            missing = set(entry.get("items", [])) - set(shard)
+            if missing:
+                raise shard_io.CheckpointCorruptError(
+                    "shard %s missing items %s"
+                    % (entry["filename"], sorted(missing)))
+            items.update(shard)
+            nbytes += int(entry.get("nbytes", 0))
+        uncovered = set(man.layout) - set(items)
+        if uncovered:
+            raise shard_io.CheckpointCorruptError(
+                "step %d: items %s in layout but in no shard"
+                % (step, sorted(uncovered)))
+        _BYTES.inc(nbytes, direction="read")
+        _RESTORE_SECONDS.observe(time.perf_counter() - t0,
+                                 phase="total")
+        return items
+
+    def restore_latest(self) -> Tuple[int, Dict[str, object]]:
+        """Restore the newest VALID committed step, falling back past
+        corrupt ones (counted in
+        ``hvd_ckpt_restore_fallbacks_total``)."""
+        steps = self.committed_steps()
+        for step in reversed(steps):
+            try:
+                return step, self.restore(step)
+            except (shard_io.CheckpointCorruptError, ValueError,
+                    OSError) as e:
+                logger.warning("ckpt: step %d failed validation (%s); "
+                               "falling back", step, e)
+                _FALLBACKS.inc()
+        raise CheckpointNotFoundError(
+            "no valid committed checkpoint under %s (checked steps "
+            "%s)" % (self.directory, steps))
+
+    # ------------------------------------------------------------------
+    # retention
+    # ------------------------------------------------------------------
+    def gc(self, keep: Optional[int] = None):
+        """Keep the newest ``keep`` committed steps; drop older ones
+        and any uncommitted step dir older than the newest committed
+        step (abandoned two-phase leftovers)."""
+        keep = self.keep if keep is None else keep
+        if keep is None:
+            return
+        committed = self.committed_steps()
+        doomed = set(committed[:-keep] if keep > 0 else committed)
+        if committed:
+            newest = committed[-1]
+            doomed.update(s for s in _mf.list_step_dirs(self.directory)
+                          if s < newest and s not in committed)
+        for step in sorted(doomed):
+            sdir = _mf.step_dir(self.directory, step)
+            try:
+                shutil.rmtree(sdir)
+                _GC_REMOVED.inc()
+                logger.debug("ckpt gc: removed step %d", step)
+            except OSError as e:
+                logger.warning("ckpt gc: failed to remove %s: %s",
+                               sdir, e)

@@ -151,7 +151,18 @@ def _maybe_init_jax_distributed(info: RankInfo):
         try:
             jax.config.update("jax_enable_recoverability", True)
         except AttributeError:
-            pass  # older jax: survivors may die with the peer
+            # jax 0.4.x: no recoverability support — survivors of a
+            # peer HARD-death die with it (the coordination service
+            # marks the dead task errored; propagating that error is
+            # unconditionally process-fatal in this jaxlib: the
+            # default missed-heartbeat/error callback LOG(FATAL)s,
+            # and installing a custom python callback crashes the
+            # error-poll thread with std::bad_cast; a barrier-free
+            # client drop makes CLEAN departures look like failures
+            # instead — measured, not speculation).  Death-recovery
+            # elastic tests skip on such jax versions; see
+            # jax_peer_death_recoverable() in tests/test_elastic_run.py.
+            pass
     heartbeat = os.environ.get("HOROVOD_JAX_HEARTBEAT_TIMEOUT")
     kwargs = {}
     if heartbeat:

@@ -136,6 +136,23 @@ class State:
         """Rebuild any world-size-dependent objects after re-init."""
         pass
 
+    # -- durable checkpoint protocol (horovod_tpu.checkpoint) ---------
+    def durable_state_dict(self) -> Dict[str, object]:
+        """Flat ``{item_name: host_value}`` view of the committed
+        snapshot, for the durable checkpoint subsystem.  Names are
+        namespaced (``obj/...``, ``tree/...``) so subclasses can
+        compose; values must pickle bit-exactly (numpy, python
+        scalars).  The dict's values must be REBOUND (not mutated) by
+        later ``save()`` calls — the async writer serializes the
+        captured references while training runs ahead."""
+        raise NotImplementedError()
+
+    def load_durable_state_dict(self, items: Dict[str, object]):
+        """Inverse of :meth:`durable_state_dict`: install the restored
+        items as BOTH the committed snapshot and the live attributes
+        (a restore is a commit you didn't have to compute)."""
+        raise NotImplementedError()
+
 
 class ObjectState(State):
     """State for a dict of picklable python objects, synchronized via
@@ -166,6 +183,20 @@ class ObjectState(State):
     def _set_attrs(self):
         for attr, value in self._saved_state.items():
             setattr(self, attr, value)
+
+    def durable_state_dict(self) -> Dict[str, object]:
+        return {"obj/" + k: v for k, v in self._saved_state.items()}
+
+    def load_durable_state_dict(self, items: Dict[str, object]):
+        restored = {k[len("obj/"):]: v for k, v in items.items()
+                    if k.startswith("obj/")}
+        # Items registered at construction but absent from the
+        # checkpoint (a new attribute added since it was written) keep
+        # their constructor values instead of vanishing.
+        merged = dict(self._saved_state)
+        merged.update(restored)
+        self._saved_state = merged
+        self._set_attrs()
 
 
 def run_fn(func: Callable, reset: Callable):

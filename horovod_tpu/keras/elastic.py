@@ -109,6 +109,31 @@ class KerasState(ObjectState):
         self.optimizer = optimizer or getattr(model, "optimizer", None)
         self._seed_from_snapshot()
 
+    def durable_state_dict(self):
+        """ObjectState capture plus model/optimizer weight snapshots.
+        Weight lists are rebound whole on ``save()``, so references
+        are stable for the async checkpoint writer; indices are
+        zero-padded so restore order survives lexicographic
+        iteration."""
+        d = super().durable_state_dict()
+        for i, w in enumerate(self._saved_model_weights or []):
+            d["keras/model.%06d" % i] = w
+        for i, w in enumerate(self._saved_opt_weights or []):
+            d["keras/opt.%06d" % i] = w
+        return d
+
+    def load_durable_state_dict(self, items):
+        super().load_durable_state_dict(items)
+        model_w = [items[k] for k in sorted(items)
+                   if k.startswith("keras/model.")]
+        opt_w = [items[k] for k in sorted(items)
+                 if k.startswith("keras/opt.")]
+        if model_w:
+            self._saved_model_weights = model_w
+        if opt_w:
+            self._saved_opt_weights = opt_w
+        self._seed_from_snapshot()
+
     def sync(self):
         weights = [np.asarray(_ops.broadcast(
             np.array(w), 0, name=f"elastic_keras/model.{i}"))

@@ -17,4 +17,8 @@ def pvary(x, axis_names):
         return x
     if hasattr(lax, "pcast"):
         return lax.pcast(x, missing, to="varying")
-    return lax.pvary(x, missing)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, missing)
+    # jax 0.4.x: no varying-axis (vma) typing exists, so there is
+    # nothing to mark — identity is exactly right.
+    return x

@@ -50,6 +50,14 @@ KEY_GENERATION = "generation"     # bumped on every discovery change
 # has no worker /metrics endpoint, so the KV store is its read path.
 METRICS_SCOPE = "metrics"
 KEY_DRIVER_METRICS = "driver"
+# Durable-checkpoint coordination scope (shared with
+# checkpoint/coordinator.py KVCommitCoordinator): the driver seeds
+# ckpt/latest from disk at startup so a job restarted after a
+# whole-job preemption learns the restore point before any worker has
+# rendezvoused (restart-from-latest-valid).
+from ...checkpoint.coordinator import KEY_LATEST as KEY_CKPT_LATEST
+from ...checkpoint.coordinator import SCOPE as CKPT_SCOPE
+from ...checkpoint.elastic import ENV_DIR as ENV_CKPT_DIR
 
 
 
@@ -88,6 +96,7 @@ class ElasticDriver:
 
         self._shutdown = threading.Event()
         self._error_message: Optional[str] = None
+        self._ckpt_latest: Optional[int] = None
         self._discovery_thread = threading.Thread(
             target=self._discover_hosts, name="hvd-elastic-discovery",
             daemon=True)
@@ -111,6 +120,7 @@ class ElasticDriver:
     def start(self, np: int, create_worker_fn: Callable[[SlotInfo], int]):
         """Wait for min_np slots, plan the first epoch, spawn workers."""
         self._create_worker_fn = create_worker_fn
+        self._seed_ckpt_latest()
         self.wait_for_available_slots(max(np or 0, self._min_np))
         with self._lock:
             self._plan_epoch()
@@ -254,6 +264,12 @@ class ElasticDriver:
             # plan and worker init is still noticed.
             "generation": self._generation,
         }
+        if self._ckpt_latest is not None:
+            # Restart-from-latest-valid: every plan advertises the
+            # newest committed checkpoint step known at job start, so
+            # workers (even ones joining epochs later) restore before
+            # the first sync instead of re-deriving it from disk scans.
+            self._world_info["ckpt_latest_step"] = self._ckpt_latest
         if self._rendezvous is not None:
             self._rendezvous.init(self._host_assignments)
         logger.info("elastic: epoch %d planned, size=%d hosts=%s",
@@ -320,6 +336,34 @@ class ElasticDriver:
                            local_rank, code)
             _WORKER_FAILURES.inc()
             self._registry.record_failure(host, local_rank)
+
+    def _seed_ckpt_latest(self):
+        """Scan ``HOROVOD_CHECKPOINT_DIR`` (when configured) for the
+        newest committed checkpoint and seed the rendezvous KV's
+        ``ckpt/latest`` key — the restart-from-latest-valid path after
+        a whole-job preemption, where no rank remembers anything."""
+        import os
+        directory = os.environ.get(ENV_CKPT_DIR)
+        if not directory:
+            return
+        try:
+            from ...checkpoint.manifest import committed_steps
+            steps = committed_steps(directory)
+        except Exception:
+            logger.exception("ckpt: scan of %s failed", directory)
+            return
+        if not steps:
+            logger.info("ckpt: no committed checkpoint under %s "
+                        "(cold start)", directory)
+            return
+        self._ckpt_latest = steps[-1]
+        logger.info("ckpt: job will restart from committed step %d "
+                    "(%s)", self._ckpt_latest, directory)
+        if self._rendezvous is not None and \
+                self._rendezvous.kvstore is not None:
+            self._rendezvous.kvstore.put(
+                CKPT_SCOPE, KEY_CKPT_LATEST,
+                str(self._ckpt_latest).encode())
 
     def _publish_metrics(self):
         """Refresh the driver's registry snapshot in the rendezvous KV

@@ -66,5 +66,6 @@ class ElasticRendezvousHandler(KVStoreHandler):
         }
         payload.update({k: v for k, v in world.items()
                         if k in ("coordinator", "controller_addr",
-                                 "rank0_addr", "generation")})
+                                 "rank0_addr", "generation",
+                                 "ckpt_latest_step")})
         return json.dumps(payload).encode()

@@ -85,6 +85,12 @@ def elastic_rendezvous(timeout: Optional[float] = None) -> Dict:
             logger.info("elastic: slot retired; exiting cleanly")
             raise HostsRemovedError()
         _last_epoch = int(info["epoch"])
+        if "ckpt_latest_step" in info:
+            # Restart-from-latest-valid: the driver found a committed
+            # durable checkpoint at job start; expose it so the
+            # binding's DurableCheckpointer restores before first sync.
+            os.environ["HOROVOD_CKPT_LATEST"] = \
+                str(info["ckpt_latest_step"])
         os.environ[env_mod.HOROVOD_RANK] = str(info["rank"])
         os.environ[env_mod.HOROVOD_SIZE] = str(info["size"])
         os.environ[env_mod.HOROVOD_LOCAL_RANK] = str(info["local_rank"])
@@ -115,6 +121,32 @@ def _resolve_endpoints(client: RendezvousClient, info: Dict,
         str(info["epoch"]), timeout)
     os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = endpoints["coordinator"]
     os.environ["HOROVOD_CONTROLLER_ADDR"] = endpoints["controller_addr"]
+
+
+def latest_committed_step() -> Optional[int]:
+    """Newest durably committed checkpoint step the driver (or any
+    rank's commit arbiter) published in the rendezvous KV, or None.
+    The on-disk manifest remains the durable truth; this is the fast
+    path a re-rendezvousing worker checks without a directory scan."""
+    from ...checkpoint.coordinator import KEY_LATEST, SCOPE
+    try:
+        raw = _client().get(SCOPE, KEY_LATEST)
+    except (OSError, KeyError):
+        return None
+    if raw is None:
+        return None
+    try:
+        return int(raw.decode())
+    except ValueError:
+        return None
+
+
+def kv_commit_coordinator():
+    """A :class:`~horovod_tpu.checkpoint.KVCommitCoordinator` over
+    this worker's rendezvous connection — the coordinator_factory for
+    DurableCheckpointer in launcher-managed elastic jobs."""
+    from ...checkpoint.coordinator import KVCommitCoordinator
+    return KVCommitCoordinator(_client())
 
 
 class RendezvousHostUpdateSource(HostUpdateSource):

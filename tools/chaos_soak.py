@@ -604,12 +604,211 @@ def run_schedule(schedule: dict, ranks: int, n_ops: int,
     }
 
 
+# ---------------------------------------------------------------------------
+# checkpoint kill-and-resume drill
+# ---------------------------------------------------------------------------
+
+def _drill_grad(rank_unused: int, step: int, shape) -> np.ndarray:
+    """Deterministic, world-size-independent 'gradient' so the
+    reference trajectory is computable in closed form: every rank
+    applies the same post-allreduce update (data parallelism)."""
+    return np.full(shape, 0.25 * ((step % 7) + 1), np.float32)
+
+
+def _drill_params_at(step: int, shape) -> np.ndarray:
+    """Closed-form reference: params after ``step`` completed steps."""
+    p = np.zeros(shape, np.float32)
+    for s in range(step):
+        p += _drill_grad(0, s, shape)
+    return p
+
+
+def run_checkpoint_drill(mode: str, ranks: int = 4, seed: int = 0,
+                         steps: int = 12, commit_every: int = 3,
+                         victim: int = None, kill_step: int = None,
+                         ckpt_dir: str = None,
+                         commit_timeout_s: float = 3.0) -> dict:
+    """Kill-and-resume: ``ranks`` thread-ranks train a deterministic
+    param vector, durably checkpointing every ``commit_every`` steps
+    through the real two-phase pipeline (horovod_tpu.checkpoint); a
+    seeded schedule kills one rank either ``mid_epoch`` (between
+    checkpoints) or ``mid_write`` (inside its shard write, via the
+    ``ckpt.shard_write`` failpoint); the 'job restart' then restores
+    from the last coordinator-committed checkpoint and the drill
+    asserts
+
+    * the restored step is the last one the arbiter committed,
+    * restored params are BIT-identical to the closed-form reference
+      at that step,
+    * step loss is bounded by the checkpoint cadence (+1 for an
+      in-flight async save), and
+    * NO step directory on disk carries a manifest that fails full
+      checksum validation — a torn or silently-corrupt checkpoint is
+      an immediate drill failure.
+    """
+    import shutil
+    import tempfile
+
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        LocalCommitCoordinator)
+    from horovod_tpu.checkpoint import manifest as _mf
+
+    assert mode in ("mid_epoch", "mid_write"), mode
+    t0 = time.monotonic()
+    rng = random.Random("%d|ckpt-drill|%s" % (seed, mode))
+    if victim is None:
+        victim = rng.randrange(1, ranks)
+    if kill_step is None:
+        # Late enough that at least one commit is guaranteed durable
+        # first: the wait-before-next-save at the SECOND boundary is
+        # what drains the first boundary's async save, so the victim
+        # must survive past 2*commit_every steps (a kill inside
+        # [commit_every, 2*commit_every) may legitimately lose the
+        # only snapshot while it is still queued — correct behavior,
+        # but nothing for the drill to assert restore against).
+        assert steps - 1 >= 2 * commit_every, (steps, commit_every)
+        kill_step = rng.randint(2 * commit_every, steps - 1)
+    owned_dir = ckpt_dir is None
+    if owned_dir:
+        ckpt_dir = tempfile.mkdtemp(prefix="hvd-ckpt-drill-")
+    shape = (257,)
+
+    def crash_handler(site):
+        raise SimCrash("injected crash at %s" % site)
+
+    # First commit boundary at/after the kill step: the save whose
+    # shard write the mid_write schedule kills.
+    kill_commit = ((kill_step + commit_every - 1)
+                   // commit_every) * commit_every
+    if mode == "mid_write":
+        # The victim dies INSIDE its shard write for checkpoint
+        # ``kill_commit`` (the failpoint fires on the victim's
+        # checkpoint writer thread; rank= context is threaded through
+        # the pipeline explicitly; after= skips the victim's earlier,
+        # healthy shard writes).
+        failpoints.configure(
+            "ckpt.shard_write=crash(times=1,rank=%d,after=%d)"
+            % (victim, kill_commit // commit_every - 1), seed=seed)
+    else:
+        failpoints.reset()
+    failpoints.set_crash_handler(crash_handler)
+
+    coord = LocalCommitCoordinator()
+    mgrs = [CheckpointManager(ckpt_dir, rank=r, world_size=ranks,
+                              coordinator=coord, keep=3,
+                              commit_timeout_s=commit_timeout_s)
+            for r in range(ranks)]
+    errors = []
+
+    def rank_loop(rank: int):
+        params = np.zeros(shape, np.float32)
+        try:
+            for step in range(steps):
+                if mode == "mid_epoch" and rank == victim and \
+                        step == kill_step:
+                    raise SimCrash("mid-epoch kill at step %d" % step)
+                params = params + _drill_grad(rank, step, shape)
+                if (step + 1) % commit_every == 0:
+                    # CheckFreq-style bounded staleness: the previous
+                    # async save must be durable before the next one
+                    # starts (also what makes the drill deterministic
+                    # — no commit is ever superseded in-queue).
+                    mgrs[rank].wait(2 * commit_timeout_s + 10)
+                    items = {"obj/step": step + 1,
+                             "tree/params": params.copy()}
+                    mgrs[rank].save_async(step + 1, items)
+                    if mode == "mid_write" and rank == victim and \
+                            step + 1 == kill_commit:
+                        # The injected crash fires inside THIS save's
+                        # shard write; the process is dead the moment
+                        # it does.  Draining makes the death ordering
+                        # deterministic.
+                        mgrs[rank].wait(2 * commit_timeout_s + 10)
+                        raise SimCrash(
+                            "mid-write kill at commit %d" % (step + 1))
+        except SimCrash:
+            # Process death: the queue dies with it — nothing this
+            # rank had not yet written can ever land.
+            mgrs[rank].abort()
+            return
+        except Exception as e:  # pragma: no cover - drill plumbing
+            errors.append("rank %d: %r" % (rank, e))
+
+    threads = [threading.Thread(target=rank_loop, args=(r,),
+                                name="ckpt-drill-r%d" % r, daemon=True)
+               for r in range(ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        if t.is_alive():
+            errors.append("%s never exited" % t.name)
+    for m in mgrs:
+        m.wait(timeout=2 * commit_timeout_s + 5)
+        m.close(timeout=1.0)
+    triggers = failpoints.snapshot()
+    failpoints.reset()
+    failpoints.set_crash_handler(None)
+
+    committed_before = coord.committed_step()
+
+    # --- 'restart': fresh managers (any world size reads any layout)
+    restore_mgr = CheckpointManager(ckpt_dir, rank=0, world_size=1)
+    record = {
+        "kind": "checkpoint_drill", "mode": mode, "ranks": ranks,
+        "seed": seed, "victim": victim, "kill_step": kill_step,
+        "steps": steps, "commit_every": commit_every,
+        "errors": errors, "failpoint_triggers": triggers,
+    }
+    try:
+        restored_step, items = restore_mgr.restore_latest()
+        restored = items["tree/params"]
+        expected = _drill_params_at(restored_step, shape)
+        bit_identical = bool(np.array_equal(restored, expected)) and \
+            restored.dtype == expected.dtype
+        # Torn/corrupt scan: EVERY manifest on disk must fully verify.
+        torn = []
+        for s in _mf.committed_steps(ckpt_dir):
+            try:
+                restore_mgr.restore(s)
+            except Exception as e:
+                torn.append({"step": s, "error": repr(e)[:200]})
+        died_at = kill_step if mode == "mid_epoch" else kill_commit
+        step_loss = died_at - restored_step
+        record.update({
+            "committed_before_kill": committed_before,
+            "died_at_step": died_at,
+            "restored_step": restored_step,
+            "bit_identical": bit_identical,
+            "step_loss": step_loss,
+            # One cadence window, +commit_every for a kill that
+            # aborted the in-flight commit of the preceding window.
+            "step_loss_bound": 2 * commit_every,
+            "torn_checkpoints": torn,
+            "ok": (bit_identical and not torn and not errors
+                   and step_loss <= 2 * commit_every
+                   and (committed_before is None
+                        or restored_step >= committed_before)),
+        })
+    except Exception as e:
+        record.update({"ok": False, "error": repr(e)[:300]})
+    finally:
+        restore_mgr.close(timeout=1.0)
+        if owned_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+    record["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return record
+
+
 def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
              n_ops: int = 30, hang_timeout_s: float = 30.0,
-             stall_shutdown_s: float = 4.0) -> dict:
+             stall_shutdown_s: float = 4.0,
+             checkpoint_drill: bool = True) -> dict:
     """Run ``schedules`` seeded schedules; returns the full artifact
     dict.  ``ok`` is True iff no schedule hung, mis-reduced, or failed
-    to recover."""
+    to recover — and, with ``checkpoint_drill``, iff both
+    kill-and-resume drills restored bit-identical params from the last
+    committed checkpoint."""
     t0 = time.monotonic()
     records = []
     for i in range(schedules):
@@ -627,10 +826,18 @@ def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
         hist.observe(lat)
     bad = [r for r in records
            if r["outcome"] in ("hang", "incorrect", "recovery_failed")]
+    drills = []
+    if checkpoint_drill:
+        for mode in ("mid_epoch", "mid_write"):
+            logger.info("checkpoint drill: %s", mode)
+            drills.append(run_checkpoint_drill(mode, ranks=min(ranks, 4),
+                                               seed=seed))
+        bad.extend(d for d in drills if not d.get("ok"))
     return {
         "ranks": ranks,
         "seed": seed,
         "schedules": records,
+        "checkpoint_drill": drills or None,
         "recovery_latency": {
             "count": len(latencies),
             "max_s": max(latencies) if latencies else None,
@@ -652,6 +859,9 @@ def main(argv=None) -> int:
     parser.add_argument("--ops", type=int, default=30)
     parser.add_argument("--hang-timeout", type=float, default=30.0)
     parser.add_argument("--stall-shutdown", type=float, default=4.0)
+    parser.add_argument("--no-ckpt-drill", action="store_true",
+                        help="skip the checkpoint kill-and-resume "
+                             "drills")
     parser.add_argument("--out", default=None,
                         help="write the JSON artifact here")
     parser.add_argument("-v", "--verbose", action="store_true")
@@ -661,7 +871,8 @@ def main(argv=None) -> int:
     report = run_soak(ranks=args.ranks, schedules=args.schedules,
                       seed=args.seed, n_ops=args.ops,
                       hang_timeout_s=args.hang_timeout,
-                      stall_shutdown_s=args.stall_shutdown)
+                      stall_shutdown_s=args.stall_shutdown,
+                      checkpoint_drill=not args.no_ckpt_drill)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)
