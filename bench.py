@@ -200,13 +200,31 @@ def bench_resnet(args, smoke: bool) -> dict:
     if not step_flops and not smoke:
         step_flops = resnet50_analytic_flops(batch_size)
 
+    # Opt-in per-HLO profile (HOROVOD_BENCH_PROFILE=1): the MFU-ceiling
+    # analysis (bytes accessed, implied HBM-bound step time, transpose/
+    # copy histogram) lands in THIS artifact instead of resting on
+    # earlier rounds' prose.  Must run before the timed loop: the loop
+    # donates params/opt_state away.
+    profile = None
+    if os.environ.get("HOROVOD_BENCH_PROFILE") == "1":
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from profile_resnet import compiled_step_summary
+            profile = compiled_step_summary(
+                train_step, (params, batch_stats, opt_state, x, labels),
+                dev, 0.0 if smoke else
+                resnet50_analytic_flops(batch_size))
+        except Exception as e:
+            profile = {"error": repr(e)[:300]}
+
     dt, noise = _timed_loop(
         lambda c: train_step(c[0], c[1], c[2], x, labels),
         (params, batch_stats, opt_state, None), warmup, iters,
         lambda c: float(c[3]))
     img_sec = batch_size * iters / dt
     peak = peak_bf16_tflops(dev)
-    return {
+    out = {
         "images_per_sec": round(img_sec, 2),
         "batch_size": batch_size,
         "spread_pct": noise["spread_pct"],
@@ -215,6 +233,9 @@ def bench_resnet(args, smoke: bool) -> dict:
         "tflops_per_sec": round(step_flops * iters / dt / 1e12, 2)
                           if step_flops else None,
     }
+    if profile is not None:
+        out["profile"] = profile
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -666,10 +687,15 @@ import horovod_tpu as hvd
 hvd.init()
 RANK = hvd.rank()
 sizes_mb = json.loads(os.environ["BENCH_SIZES_MB"])
+ITERS_CAP = int(os.environ.get("BENCH_ITERS_CAP", "0"))
 results = []
 for mb in sizes_mb:
     n = int(mb * 1024 * 1024 // 4)
     iters = max(3, int(64 / mb))
+    if ITERS_CAP:
+        # Scale lanes (8-16 ranks on a shared CPU) cap the per-size op
+        # count so the lane measures scaling, not the rig's patience.
+        iters = min(iters, ITERS_CAP)
     for kind in ("numpy", "jax"):
         buf = np.full((n,), float(RANK + 1), np.float32)
         if kind == "jax":
@@ -723,12 +749,51 @@ def timed_floor(fn, warmup=5, chunks=5, per=40):
 
 # Control-plane latency floor: a 1-element allreduce and a barrier
 # time the pure submit->CH->CB->dispatch->callback round (no data).
+# Two lanes: replay DISABLED measures the negotiated CH/CB round-trip
+# (the pre-round-6 steady state); replay ENABLED measures the frozen-
+# schedule fast path, with the uplink frame counters sampled around it
+# to prove the replayed ops put ZERO frames on the wire.
+from horovod_tpu.common import basics
+from horovod_tpu.common import metrics as _hm
+_rt = basics._state().runtime
+_rp = _rt.replay
+
 tiny = np.ones(1, np.float32)
-tiny_floor = timed_floor(
-    lambda: hvd.allreduce(tiny, op=hvd.Sum, name="bench.tiny"))
+
+
+def tiny_op():
+    hvd.allreduce(tiny, op=hvd.Sum, name="bench.tiny")
+
+
+if _rp is not None:
+    _rp.set_enabled(False)
+tiny_floor = timed_floor(tiny_op)
 barrier_floor = timed_floor(hvd.barrier)
 
-from horovod_tpu.common import basics
+replay_floor = None
+replay_engaged = False
+frames_during_replay = None
+if _rp is not None:
+    _rp.set_enabled(True)
+    for _ in range(8):   # converge + enter (warmup K cycles)
+        tiny_op()
+    replay_engaged = bool(_rp.stats()["active"])
+    _f0 = dict(_rt.controller.stats)
+    replay_floor = timed_floor(tiny_op)
+    _f1 = dict(_rt.controller.stats)
+    frames_during_replay = sum(
+        _f1[k] - _f0[k] for k in ("rq_frames", "ch_frames"))
+
+_c = _hm.REGISTRY.counter
+replay_stats = {
+    "engaged": replay_engaged,
+    "entries": _c("hvd_steady_state_entries").value(),
+    "cycles_replayed":
+        _c("hvd_steady_state_cycles_replayed").value(),
+    "exits": _c("hvd_steady_state_exits").snapshot() or {},
+    "uplink_frames_during_replay_floor": frames_during_replay,
+}
+
 stats = dict(basics._state().runtime.controller.stats)
 backend_stats = dict(getattr(basics._state().backend, "stats", {}))
 # Registry snapshot: records fusion efficiency, cache hit rate, and
@@ -739,6 +804,7 @@ if RANK == 0:
     print("BENCHJSON " + json.dumps({
         "results": results, "frames": stats,
         "metrics": metrics_snap,
+        "replay": replay_stats,
         "backend": {"type": type(basics._state().backend).__name__,
                     "ring_shm": backend_stats.get("ring_shm"),
                     "ring_allreduces":
@@ -746,6 +812,8 @@ if RANK == 0:
         "control_floor": {
             "tiny_allreduce_ms": tiny_floor["median_ms"],
             "tiny_allreduce": tiny_floor,
+            "tiny_replay_ms": (replay_floor or {}).get("median_ms"),
+            "tiny_replay": replay_floor,
             "barrier_ms": barrier_floor["median_ms"],
             "barrier": barrier_floor}}))
 hvd.shutdown()
@@ -768,12 +836,12 @@ def _free_ports(n):
 
 
 def bench_collectives(sizes_mb, nproc=2, timeout=600,
-                      plane=None) -> dict:
+                      plane=None, iters_cap=0) -> dict:
     """Spawn nproc CPU worker processes exercising hvd.allreduce through
-    the full eager path: TCP controller + cache fast path + the data
-    plane (default = native ring incl. same-host shm; plane="XLA"
-    forces the XLA mesh backend for a control lane). gbps is per-rank
-    effective throughput (payload bytes / wall time)."""
+    the full eager path: TCP controller + cache fast path + steady-state
+    replay + the data plane (default = native ring incl. same-host shm;
+    plane="XLA" forces the XLA mesh backend for a control lane). gbps is
+    per-rank effective throughput (payload bytes / wall time)."""
     repo = os.path.dirname(os.path.abspath(__file__))
     coord_port, ctrl_port = _free_ports(2)
     procs = []
@@ -788,6 +856,7 @@ def bench_collectives(sizes_mb, nproc=2, timeout=600,
             "HOROVOD_CONTROLLER_ADDR": "127.0.0.1:%d" % ctrl_port,
             "HOROVOD_TPU_FORCE_CPU": "1",
             "BENCH_SIZES_MB": json.dumps(sizes_mb),
+            "BENCH_ITERS_CAP": str(iters_cap),
             "PYTHONPATH": repo,
         })
         # Scrub any ambient plane choice: the baseline lane must be
@@ -820,8 +889,63 @@ def bench_collectives(sizes_mb, nproc=2, timeout=600,
     return {"error": "no result line: %s" % outs[0][-800:]}
 
 
+def bench_scale(args, smoke: bool) -> dict:
+    """The 8-rank eager scale lane (16 behind
+    HOROVOD_BENCH_SCALE_RANKS): the same real control plane + data
+    plane as `allreduce_eager`, but at the first scale a pod
+    deployment would hit — reporting GB/s, the negotiated vs replay
+    control floor, the response-cache hit rate, and replay engagement
+    beyond 2 ranks."""
+    nproc = int(os.environ.get("HOROVOD_BENCH_SCALE_RANKS", "8"))
+    sizes = [1] if smoke else [1, 4]
+    data = bench_collectives(sizes, nproc=nproc, timeout=900,
+                             iters_cap=24)
+    if "error" in data:
+        return data
+    counters = (data.get("metrics") or {}).get("counters") or {}
+    cache = counters.get("hvd_response_cache_total") or {}
+    if not isinstance(cache, dict):
+        cache = {}
+    hits = float(cache.get("event=hit", 0.0))
+    misses = float(cache.get("event=miss", 0.0))
+    data["cache_hit_rate"] = round(hits / (hits + misses), 4) \
+        if hits + misses else None
+    # The full registry snapshot is already in the 2-proc lane when
+    # that lane runs; under --only scale this is the only snapshot,
+    # so keep it.
+    if args.only != "scale":
+        data.pop("metrics", None)
+    return data
+
+
 LAST_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_LAST_TPU.json")
+
+
+def _sweep_marked_processes(marker: str):
+    """SIGKILL any surviving process whose environment carries the
+    probe marker.  ``killpg`` misses descendants that called setsid
+    (accelerator-plugin helpers do); a leaked helper keeps burning CPU
+    for the rest of the bench — the r05 smoke regression (37.3 → 31.6
+    img/s after two 120s timed-out probes; current code re-measures at
+    ~37 on an idle rig) is exactly that contention.  The env marker
+    makes every descendant findable regardless of session games."""
+    killed = []
+    try:
+        pids = os.listdir("/proc")
+    except OSError:
+        return killed  # no procfs (macOS): nothing to sweep
+    for pid in pids:
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                if marker.encode() in f.read():
+                    os.kill(int(pid), signal.SIGKILL)
+                    killed.append(int(pid))
+        except OSError:
+            continue
+    return killed
 
 
 def _probe_once(timeout_s: float):
@@ -830,13 +954,16 @@ def _probe_once(timeout_s: float):
     lone ``Popen.kill`` can leave a grandchild holding the device
     claim — which both wedges the next attempt and leaks the claim the
     probe exists to protect.  Returns (info|None, error|None,
-    full_child_output)."""
+    full_child_output, killed_descendants)."""
     src = ("import json, jax\n"
            "d = jax.devices()[0]\n"
            "print('PROBE ' + json.dumps("
            "{'platform': d.platform, "
            "'kind': getattr(d, 'device_kind', str(d))}))\n")
-    p = subprocess.Popen([sys.executable, "-c", src],
+    marker = "HVDPROBE%d_%d" % (os.getpid(), time.monotonic_ns())
+    env = dict(os.environ)
+    env["HOROVOD_BENCH_PROBE_MARK"] = marker
+    p = subprocess.Popen([sys.executable, "-c", src], env=env,
                          stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT,
                          start_new_session=True)
@@ -855,20 +982,23 @@ def _probe_once(timeout_s: float):
         except subprocess.TimeoutExpired:
             p.kill()
             raw = b"(probe output unreadable: descendant kept pipe open)"
+        killed = _sweep_marked_processes(marker)
         txt = raw.decode(errors="replace")
         return None, ("TPU probe timed out after %.0fs (wedged device "
-                      "claim?)" % timeout_s), txt
+                      "claim?)" % timeout_s), txt, killed
+    killed = _sweep_marked_processes(marker)
     txt = raw.decode(errors="replace")
     if p.returncode != 0:
-        return None, "TPU probe failed (rc=%s)" % p.returncode, txt
+        return None, "TPU probe failed (rc=%s)" % p.returncode, txt, \
+            killed
     for line in txt.splitlines():
         if line.startswith("PROBE "):
             # A clean CPU-only answer is NOT an outage — the host
             # simply has no TPU; the caller runs the full-size bench
             # on CPU exactly as before.  Only timeouts/errors above
             # are treated as a wedged tunnel.
-            return json.loads(line[len("PROBE "):]), None, txt
-    return None, "TPU probe produced no output", txt
+            return json.loads(line[len("PROBE "):]), None, txt, killed
+    return None, "TPU probe produced no output", txt, killed
 
 
 def probe_tpu(timeout_s: float = None, attempts: int = None,
@@ -908,11 +1038,15 @@ def probe_tpu(timeout_s: float = None, attempts: int = None,
         if i:
             time.sleep(backoff_s * i)  # 45s, 90s, ... spread
         t0 = time.time()
-        info, err, txt = _probe_once(timeout_s)
+        info, err, txt, killed = _probe_once(timeout_s)
         diag["attempts"].append({
             "attempt": i + 1,
             "elapsed_s": round(time.time() - t0, 1),
             "error": err,
+            # Escaped-descendant sweep: a non-empty list here is CPU
+            # contention the rest of the bench would otherwise have
+            # silently paid (the r05 smoke-regression mechanism).
+            "leaked_descendants_killed": killed,
             # Full output, bounded only by sanity (probe chatter is
             # a few KB of absl/jax warnings + the failure).
             "child_output": txt[-8192:],
@@ -926,15 +1060,32 @@ def probe_tpu(timeout_s: float = None, attempts: int = None,
     return None, err, diag
 
 
+def _current_round(repo_dir: str):
+    """The round number this bench run belongs to: one past the
+    highest BENCH_r*.json already committed (the driver writes the
+    artifact for round N after the run)."""
+    import glob
+    import re
+    rounds = []
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r0*(\d+)\.json$", path)
+        if m:
+            rounds.append(int(m.group(1)))
+    return (max(rounds) + 1) if rounds else None
+
+
 def save_last_tpu(out: dict):
     """Persist a successful full-size TPU result so a later tunnel
     outage can still surface driver-verifiable evidence (clearly
-    labeled stale) instead of leaving the round evidence-free."""
+    labeled stale, with its capture round) instead of leaving the
+    round evidence-free."""
     try:
         with open(LAST_TPU_CACHE, "w") as f:
             json.dump({"timestamp": time.time(),
                        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()),
+                       "captured_round": _current_round(
+                           os.path.dirname(os.path.abspath(__file__))),
                        "result": out}, f, indent=1)
     except OSError:
         pass
@@ -1042,7 +1193,7 @@ def main():
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
-                        "collectives", "checkpoint"],
+                        "collectives", "checkpoint", "scale"],
                    default=None)
     args = p.parse_args()
 
@@ -1083,8 +1234,20 @@ def main():
         if probe_diag is not None:
             out["tpu_probe"] = probe_diag
 
+    # CPU-contention context for every timed section below: a non-idle
+    # load average before the benches start means the numbers carry a
+    # rig tax (the r05 smoke regression was leaked probe descendants —
+    # now swept and recorded above — burning the second core).
+    try:
+        out["cpu"] = {"count": os.cpu_count(),
+                      "load_avg_start": [round(x, 2)
+                                         for x in os.getloadavg()]}
+    except OSError:
+        pass
+
     run = {args.only} if args.only else {"resnet", "bert", "keras",
-                                     "collectives", "checkpoint"}
+                                     "collectives", "checkpoint",
+                                     "scale"}
 
     resnet = {}
     if "resnet" in run:
@@ -1135,6 +1298,11 @@ def main():
                     "error": repr(e)[:200]}
         except Exception as e:
             out["allreduce_eager"] = {"error": repr(e)[:300]}
+    if "scale" in run:
+        try:
+            out["scale_eager"] = bench_scale(args, args.smoke)
+        except Exception as e:
+            out["scale_eager"] = {"error": repr(e)[:300]}
 
     if args.smoke:
         check_smoke_regression(
@@ -1159,11 +1327,28 @@ def main():
         save_last_tpu(out)
     elif tpu_error:
         # Tunnel outage: carry the last driver-verifiable TPU result
-        # (clearly marked stale, with its age) next to the CPU
-        # fallback numbers.
+        # (clearly marked stale, with its age and capture round) next
+        # to the CPU fallback numbers — AND let it degrade the
+        # headline instead of zeroing it: a wedged claim should read
+        # as "stale N-round-old 2650 img/s", not "0".
         cached = load_last_tpu()
         if cached:
             out["last_tpu"] = cached
+            stale_img = ((cached.get("result") or {})
+                         .get("resnet50") or {}).get("images_per_sec")
+            if stale_img:
+                out["headline"] = {
+                    "metric": "resnet50_images_per_sec_per_chip",
+                    "value": stale_img,
+                    "stale": True,
+                    "captured_round": cached.get("captured_round"),
+                    "age_hours": cached.get("age_hours"),
+                }
+                out["metric"] = \
+                    "resnet50_images_per_sec_per_chip_stale"
+                out["value"] = stale_img
+                out["vs_baseline"] = round(
+                    stale_img / REFERENCE_IMG_SEC_PER_DEVICE, 3)
     print(json.dumps(out))
 
 

@@ -540,6 +540,7 @@ def add_process_set(ranks) -> ProcessSet:
     ps.process_set_id = state.next_process_set_id
     state.next_process_set_id += 1
     state.process_sets.append(ps)
+    _invalidate_replay("process_set_change")
     return ps
 
 
@@ -550,3 +551,13 @@ def remove_process_set(ps: ProcessSet):
         # Unregistered again: submit-time validation rejects it until
         # re-added (which assigns a FRESH id — ids are never reused).
         ps.process_set_id = -1
+        _invalidate_replay("process_set_change")
+
+
+def _invalidate_replay(reason: str):
+    """Process-set membership changed: a frozen steady-state schedule
+    may reference the old grouping — exit replay / reset convergence
+    (collective call, so every rank invalidates at the same point)."""
+    rt = _state().runtime
+    if rt is not None and getattr(rt, "replay", None) is not None:
+        rt.replay.note_disruption(reason)

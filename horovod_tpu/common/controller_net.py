@@ -77,6 +77,11 @@ _ROUNDS = metrics.counter(
 _COORD_TENSORS = metrics.counter(
     "hvd_negotiated_tensors_total",
     "Tensors completed on the coordinator, by path")
+_UPLINK_BATCH = metrics.histogram(
+    "hvd_uplink_requests_per_frame",
+    "Requests/bits coalesced into one uplink frame, by kind (drain-"
+    "all-pending coalescing: frame count tracks batch count, not "
+    "tensor count)", bounds=metrics.COUNT_BUCKETS)
 
 
 def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
@@ -917,6 +922,7 @@ class NetworkController(Controller):
         # True while an MR (metrics snapshot) reply thread is in
         # flight; written only by the recv thread.
         self._mr_sending = False
+        self._replay_observer = None
         addr = os.environ.get(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
@@ -966,6 +972,14 @@ class NetworkController(Controller):
         the runtime wires its wake event here so response pickup is
         event-driven instead of a poll."""
         self._on_receive = fn
+
+    def set_replay_observer(self, observer):
+        """Steady-state replay hook (common/replay.py): the recv thread
+        reports response/eviction/param frames so the tracker can
+        detect converged cycles and exit replay on invalidation.
+        Observation happens BEFORE delivery, so by the time a blocked
+        submitter wakes the tracker has already recorded its response."""
+        self._replay_observer = observer
 
     def set_response_callback(self, fn):
         """Direct dispatch: the recv thread executes each response by
@@ -1133,6 +1147,8 @@ class NetworkController(Controller):
 
     def _set_broken(self, err):
         self._broken_err = err
+        if self._replay_observer is not None:
+            self._replay_observer.on_broken()
         cb = getattr(self, "_on_broken", None)
         if cb is not None:
             try:
@@ -1179,15 +1195,21 @@ class NetworkController(Controller):
                 continue
             if magic == _MAGIC_CACHE:
                 self.stats["cb_frames"] += 1
-                responses = self._reconstruct_cached(
-                    unpack_bit_batches(payload))
+                batches = unpack_bit_batches(payload)
+                responses = self._reconstruct_cached(batches)
                 if responses is None:
                     return  # desync; _broken_err set
+                if self._replay_observer is not None:
+                    self._replay_observer.on_responses(
+                        "cb", list(zip(responses, batches)))
                 self._deliver(responses)
                 continue
             if magic == _MAGIC_EVICT:
                 self.stats["ev_frames"] += 1
-                self.cache.evict_bits(unpack_bits(payload))
+                bits = unpack_bits(payload)
+                self.cache.evict_bits(bits)
+                if self._replay_observer is not None:
+                    self._replay_observer.on_evictions(bits)
                 continue
             if magic == _MAGIC_ABORT:
                 from .exceptions import HorovodInternalError
@@ -1197,6 +1219,8 @@ class NetworkController(Controller):
             if magic == _MAGIC_PARAMS:
                 self.stats["pa_frames"] += 1
                 params = json.loads(payload.decode())
+                if self._replay_observer is not None:
+                    self._replay_observer.on_params()
                 if self._on_response is not None:
                     # Direct dispatch executes batches in-stream, so
                     # by the time the PA frame is decoded every batch
@@ -1217,6 +1241,9 @@ class NetworkController(Controller):
             self.stats["rs_frames"] += 1
             responses, _ = unpack_response_list(payload)
             self._seed_cache(responses)
+            if self._replay_observer is not None:
+                self._replay_observer.on_responses(
+                    "rs", [(r, ()) for r in responses])
             self._deliver(responses)
 
     def _send_frame_counted_locked(self, magic: bytes, payload: bytes,
@@ -1399,10 +1426,12 @@ class NetworkController(Controller):
             try:
                 with self._send_lock:
                     if hit_bits:
+                        _UPLINK_BATCH.observe(len(hit_bits), kind="CH")
                         self._send_frame_counted_locked(
                             _MAGIC_HITS, pack_bits(hit_bits),
                             "ch_frames", "CH")
                     if full:
+                        _UPLINK_BATCH.observe(len(full), kind="RQ")
                         self._send_frame_counted_locked(
                             _MAGIC_REQ, pack_request_list(full),
                             "rq_frames", "RQ")

@@ -37,6 +37,12 @@ HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+# Steady-state replay: after N converged cache-hit cycles a rank
+# freezes the fused response schedule and executes it locally with no
+# coordinator round-trips (common/replay.py).  On by default; 0/false
+# disables.
+HOROVOD_STEADY_STATE_REPLAY = "HOROVOD_STEADY_STATE_REPLAY"
+HOROVOD_REPLAY_WARMUP_CYCLES = "HOROVOD_REPLAY_WARMUP_CYCLES"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
@@ -162,6 +168,8 @@ class Knobs:
     hierarchical_allreduce: Optional[bool] = None
     hierarchical_allgather: bool = False
     autotune: bool = False
+    replay_enabled: bool = True
+    replay_warmup_cycles: int = 3
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
@@ -187,6 +195,9 @@ class Knobs:
             hierarchical_allreduce=env_bool_opt(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             autotune=env_bool(HOROVOD_AUTOTUNE),
+            replay_enabled=env_bool(HOROVOD_STEADY_STATE_REPLAY, True),
+            replay_warmup_cycles=env_int(HOROVOD_REPLAY_WARMUP_CYCLES,
+                                         3),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG),
             autotune_warmup_samples=env_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
             autotune_steps_per_sample=env_int(

@@ -27,6 +27,7 @@ from . import metrics
 from . import timeline as tl
 from .controller import LoopbackController
 from .message import (Request, RequestType, Response, ResponseType)
+from .replay import SteadyStateReplay
 from .stall_inspector import StallInspector
 from .tensor_queue import TensorQueue, TensorTableEntry
 
@@ -95,6 +96,18 @@ class BackgroundRuntime:
                                    "try_inline_cache_hit")
         elif hasattr(self.controller, "set_receive_callback"):
             self.controller.set_receive_callback(self._wake.set)
+        # Steady-state replay (common/replay.py): negotiation-free
+        # execution of converged cycles.  Networked worlds only (a
+        # loopback world has no round-trip to skip); autotune runs are
+        # excluded — PA frames re-knob fusion mid-stream, which replay
+        # would freeze past.
+        self.replay: Optional[SteadyStateReplay] = None
+        if self._inline and state.knobs.replay_enabled and \
+                not state.knobs.autotune:
+            self.replay = SteadyStateReplay(
+                self, warmup_cycles=state.knobs.replay_warmup_cycles)
+            if hasattr(self.controller, "set_replay_observer"):
+                self.controller.set_replay_observer(self.replay)
         self._thread: Optional[threading.Thread] = None
         self._cycle_time_s = state.knobs.cycle_time_ms / 1000.0
         self._entry_sizes: Dict[tuple, int] = {}  # (psid, name)
@@ -121,6 +134,15 @@ class BackgroundRuntime:
         """While joined, this rank substitutes zeros for collectives it
         did not submit (JoinOp, reference collective_operations.h:259)."""
         self._joined = flag
+        if flag and self.replay is not None:
+            # Join changes every cached response's validity (zeros get
+            # substituted for this rank); negotiate until re-converged.
+            self.replay.note_disruption("join")
+
+    def wake(self):
+        """Wake the background cycle (replay exit flushes its partial
+        batch into the negotiation queue and needs a cycle now)."""
+        self._wake.set()
 
     def _make_controller(self):
         if self.state.rank_info.size == 1:
@@ -146,11 +168,36 @@ class BackgroundRuntime:
             nelem *= d
         self._entry_sizes[(request.process_set_id,
                            request.tensor_name)] = nelem
+        replay = self.replay
+        if replay is not None and not self._joined:
+            if replay.active and replay.eligible(request):
+                # Frozen schedule: match + execute locally, no wire
+                # traffic.  False = replay just exited (unseen tensor,
+                # signature change, armed failpoint, ...) — fall
+                # through; THIS request rides the negotiation round.
+                if replay.replay_submit(request, entry):
+                    return
+            elif replay.eligible(request):
+                if replay.observe_submit(request) and \
+                        replay.replay_submit(request, entry):
+                    return
+            else:
+                # Joins/barriers/allgathers/alltoalls break cycle
+                # convergence (see replay.py for why).
+                replay.note_disruption(
+                    request.request_type.name.lower())
         if self.timeline:
             self.timeline.negotiate_start(
                 request.tensor_name, request.request_type.name)
+        # Inline fast path only from an IDLE table: during an async
+        # burst (N grads submitted before any completes) the first op
+        # goes inline and the rest queue, so the background drain sends
+        # them as ONE coalesced CH/RQ frame per kind instead of one
+        # frame per tensor — look-ahead fusion then sees whole cycles
+        # (r05 measured one RQ frame per tensor).  Synchronous loops
+        # always see an idle table, so the tiny-op floor is unchanged.
         if self._inline and request.group_id < 0 and not self._joined \
-                and self.tensor_queue.pending_count() == 0:
+                and self.tensor_queue.outstanding() == 0:
             # Inline cache-hit fast path: entry lands in the table
             # FIRST (the recv thread may dispatch the response
             # immediately), then the CH frame goes out on THIS thread
@@ -191,6 +238,10 @@ class BackgroundRuntime:
                      entries: List[TensorTableEntry]):
         if self._error is not None:
             raise self._error
+        if self.replay is not None:
+            # Grouped submissions negotiate (group atomicity is the
+            # coordinator's job); they also invalidate a frozen cycle.
+            self.replay.note_disruption("group")
         group_id = next(self._group_counter)
         for entry in entries:
             entry.callback = _latency_wrapped(entry.callback)
@@ -239,6 +290,11 @@ class BackgroundRuntime:
         thread direct-dispatches responses, so without this a frame
         arriving mid-shutdown would execute against a closed/freed
         backend."""
+        if self.replay is not None:
+            # Exit replay BEFORE disabling dispatch so a final partial
+            # batch flushes into the (about-to-be-failed) queue rather
+            # than executing against a closing backend.
+            self.replay.set_enabled(False)
         self.stop_background()
         self._dispatch_disabled = True
         # A dispatch that passed the disabled check before we set it
@@ -299,6 +355,20 @@ class BackgroundRuntime:
                 fn(err)
             except Exception:
                 logger.warning("fatal listener failed", exc_info=True)
+
+    def replay_execute(self, resp: Response):
+        """Execute a frozen-schedule response on the SUBMITTING thread
+        (steady-state replay): same serialization and error contract as
+        recv-thread direct dispatch — replay must never overlap a
+        quiesce()'d backend teardown or another dispatch."""
+        with self._dispatch_lock:
+            if self._dispatch_disabled:
+                return  # quiesced: entries already flushed with error
+            try:
+                self._perform_operation(resp)
+            except Exception as e:
+                logger.exception("replay dispatch error")
+                self._on_fatal(e)
 
     def _dispatch_response(self, resp: Response):
         """Executes on the controller's recv thread (direct dispatch).

@@ -84,6 +84,13 @@ class TensorQueue:
         with self._lock:
             self._pending.append(request)
 
+    def queue_requests(self, requests: List[Request]):
+        """Bulk variant of :meth:`queue_request` (steady-state replay
+        exiting with a partially-submitted batch): one lock round for
+        the whole flush, preserving submission order."""
+        with self._lock:
+            self._pending.extend(requests)
+
     def pop_pending(self) -> List[Request]:
         """Drain the pending-request queue (one negotiation cycle's worth)."""
         with self._lock:

@@ -147,6 +147,14 @@ class Timeline:
                 "name": name, "ph": "C", "pid": 0, "tid": 0,
                 "ts": self._ts_us(), "args": dict(values)})
 
+    def instant(self, name: str):
+        """Process-scoped instant event (steady-state replay
+        enter/exit marks and similar one-shot state flips)."""
+        if self.writer:
+            self.writer.enqueue({
+                "name": name, "ph": "i", "pid": 0, "tid": 0,
+                "ts": self._ts_us(), "s": "p"})
+
     def mark_cycle_start(self):
         if self.writer and self.mark_cycles:
             self.writer.enqueue({

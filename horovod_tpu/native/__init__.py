@@ -14,6 +14,7 @@ import ctypes
 import logging
 import os
 import subprocess
+import sys
 import threading
 
 logger = logging.getLogger("horovod_tpu.native")
@@ -34,14 +35,14 @@ def _env_enabled() -> bool:
         not in ("0", "false", "off", "no")
 
 
-def ensure_built() -> bool:
+def ensure_built(force: bool = False) -> bool:
     """Compile the shared library if missing/stale; returns success."""
     if not os.path.exists(_SRC):
         return False
     srcs = [_SRC]
     if os.path.exists(_SRC_COLL):
         srcs.append(_SRC_COLL)
-    if os.path.exists(_LIB) and all(
+    if not force and os.path.exists(_LIB) and all(
             os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs):
         return True
     os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -50,8 +51,14 @@ def ensure_built() -> bool:
     # file — each builds privately, the atomic replace makes the last
     # one win with a complete .so either way.
     tmp = "%s.tmp.%d" % (_LIB, os.getpid())
+    # -lrt: shm_open/shm_unlink (collectives.cc's same-host shm data
+    # plane) live in librt until glibc 2.34; linking a shared object
+    # leaves them silently unresolved, so without this the build
+    # "succeeds" and dlopen fails at first load.
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
            *srcs, "-o", tmp]
+    if sys.platform.startswith("linux"):
+        cmd.append("-lrt")  # macOS/musl have shm_open in libc, no librt
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
@@ -83,8 +90,18 @@ def load():
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError:
-            logger.warning("could not load %s", _LIB, exc_info=True)
-            return None
+            # A cached .so from an older build recipe (or another
+            # glibc) can be unloadable while looking fresh by mtime —
+            # rebuild once before falling back to Python.
+            logger.warning("could not load %s; rebuilding", _LIB,
+                           exc_info=True)
+            if not ensure_built(force=True):
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                logger.warning("could not load %s", _LIB, exc_info=True)
+                return None
         lib.hvd_coord_create.restype = ctypes.c_void_p
         lib.hvd_coord_create.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int,

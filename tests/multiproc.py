@@ -81,6 +81,15 @@ def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
         if per_rank_env:
             supplied.update({k: str(v)
                              for k, v in per_rank_env(rank).items()})
+        # Steady-state replay OFF by default in worker tests: these
+        # suites are the CH/CB negotiation-protocol tests, and replay
+        # (round 6) legitimately stops steady-state wire traffic their
+        # frame-count assertions depend on.  Negotiation remains the
+        # warm-up/fallback path so this coverage stays load-bearing;
+        # replay has its own opt-in suite
+        # (tests/test_steady_state_replay.py passes the env
+        # explicitly), the chaos kill drill, and the bench lanes.
+        supplied.setdefault("HOROVOD_STEADY_STATE_REPLAY", "0")
         env.update(supplied)
         # Workers default to 1 CPU device: scrub the conftest's
         # 8-device XLA_FLAGS unless the test supplied its own.

@@ -82,8 +82,10 @@ def test_probe_retries_and_full_output(bench, monkeypatch):
     def fake_probe_once(timeout_s):
         calls.append(timeout_s)
         if len(calls) < 3:
-            return None, "TPU probe failed (rc=1)", "boom %d" % len(calls)
-        return {"platform": "tpu", "kind": "TPU v5e"}, None, "PROBE ok"
+            return (None, "TPU probe failed (rc=1)",
+                    "boom %d" % len(calls), [])
+        return ({"platform": "tpu", "kind": "TPU v5e"}, None,
+                "PROBE ok", [])
 
     monkeypatch.setattr(bench, "_probe_once", fake_probe_once)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
@@ -101,7 +103,8 @@ def test_probe_clean_cpu_is_not_an_outage(bench, monkeypatch):
     full-size CPU benches to smoke and attach stale TPU evidence)."""
     monkeypatch.setattr(
         bench, "_probe_once",
-        lambda t: ({"platform": "cpu", "kind": "cpu"}, None, "PROBE"))
+        lambda t: ({"platform": "cpu", "kind": "cpu"}, None, "PROBE",
+                   []))
     info, err, diag = bench.probe_tpu(timeout_s=5, attempts=3)
     assert err is None
     assert info["platform"] == "cpu"
@@ -121,10 +124,11 @@ def test_probe_once_parses_real_child(bench, monkeypatch):
              "\"kind\": \"TPU v5e\"}')"], **kw)
 
     monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
-    info, err, txt = bench._probe_once(timeout_s=30)
+    info, err, txt, killed = bench._probe_once(timeout_s=30)
     assert err is None
     assert info == {"platform": "tpu", "kind": "TPU v5e"}
     assert "PROBE" in txt
+    assert killed == []  # a clean child leaves no marked descendants
 
 
 def test_probe_total_wall_cap(bench, monkeypatch):
@@ -139,7 +143,7 @@ def test_probe_total_wall_cap(bench, monkeypatch):
     def fake_probe_once(timeout_s):
         clock["t"] += timeout_s
         return None, "TPU probe timed out after %.0fs (wedged device " \
-            "claim?)" % timeout_s, ""
+            "claim?)" % timeout_s, "", []
 
     monkeypatch.setattr(bench, "_probe_once", fake_probe_once)
     monkeypatch.setenv("HOROVOD_BENCH_TPU_PROBE_TOTAL", "300")

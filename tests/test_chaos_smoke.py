@@ -13,7 +13,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))), "tools"))
 
 from chaos_soak import (BASELINE_SPEC, generate_schedule,  # noqa: E402
-                        run_schedule, run_soak)
+                        run_replay_kill_drill, run_schedule, run_soak)
 
 
 @pytest.mark.chaos
@@ -85,6 +85,32 @@ def test_chaos_soak_16_ranks():
     report = run_soak(ranks=16, schedules=4, seed=11, n_ops=20,
                       stall_shutdown_s=2.0)
     assert report["ok"], report["outcomes"]
+
+
+@pytest.mark.chaos
+def test_replay_kill_drill_bounded_recovery_8_ranks():
+    """A rank dying MID-REPLAY (steady-state schedules frozen on every
+    rank, zero wire traffic in flight): survivors blocked inside
+    replayed collectives must surface bounded errors — never hang —
+    and a rebuilt world must verify.  The kill is harness-driven, not
+    failpoint-driven: an armed failpoint exits replay by design, so
+    this is the one fault the failpoint soaks structurally cannot
+    reach."""
+    rec = run_replay_kill_drill(ranks=8, seed=3, hang_timeout_s=20.0,
+                                stall_shutdown_s=2.0)
+    assert rec["ok"], {k: rec[k] for k in
+                       ("hangs", "incorrect", "recovery_error",
+                        "replay_entries", "survivors_engaged")}
+    assert not rec["hangs"] and not rec["incorrect"]
+    assert rec["replay_entries"] >= 8, \
+        "replay never engaged on all ranks"
+    assert rec["cycles_replayed"] >= 1
+    assert rec["survivors_engaged"]
+    # Every survivor observed the death as an ERROR, bounded by the
+    # exchange timeout / stall shutdown — not a hang budget blowout.
+    assert len(rec["failures"]) >= 2
+    assert rec["recovery_latency_s"] is not None
+    assert rec["recovery_latency_s"] < 30.0
 
 
 def test_schedule_generation_deterministic():

