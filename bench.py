@@ -674,6 +674,141 @@ def check_ckpt_regression(out: dict, repo_dir: str):
 
 
 # ---------------------------------------------------------------------------
+# Recovery lane: measured MTTR (detect -> restore -> resume)
+# ---------------------------------------------------------------------------
+
+def bench_recovery(args, smoke: bool) -> dict:
+    """MTTR with a number on it: the chaos MTTR drill (8 in-process
+    ranks over the real control plane, liveness + reconnect armed,
+    durable checkpoints) killed/wedged/transiently-dropped repeatedly;
+    the artifact records kill-to-first-post-restore-step percentiles,
+    the detection bound actually achieved, and whether the replay fast
+    path re-engaged after every recovery — the recovery analog of the
+    tiny-op floor."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from chaos_soak import _percentile, run_mttr_drill
+
+    reps = 2 if smoke else 4
+    interval = 0.4
+    cells = []
+    for rep in range(reps):
+        for fault in ("kill", "wedge"):
+            cells.append(run_mttr_drill(
+                fault=fault, when="idle", ranks=8, seed=rep,
+                liveness_interval_s=interval))
+    drop = run_mttr_drill(fault="conn_drop", when="during_negotiation",
+                          ranks=8, seed=0,
+                          liveness_interval_s=interval)
+    mttrs = [c["mttr_s"] for c in cells if c.get("mttr_s") is not None]
+    detects = {fault: [c["detect_s"] for c in cells
+                       if c["fault"] == fault and
+                       c.get("detect_s") is not None]
+               for fault in ("kill", "wedge")}
+    restores = [c["restore_s"] for c in cells
+                if c.get("restore_s") is not None]
+    from horovod_tpu.common import metrics as _hm
+    snap = _hm.snapshot()
+    return {
+        "ranks": 8,
+        "liveness_interval_s": interval,
+        "cells": len(cells) + 1,
+        "cells_ok": all(c.get("ok") for c in cells) and drop.get("ok"),
+        "mttr_ms": {
+            "p50": round(1e3 * _percentile(mttrs, 50), 1)
+            if mttrs else None,
+            "p90": round(1e3 * _percentile(mttrs, 90), 1)
+            if mttrs else None,
+            "max": round(1e3 * max(mttrs), 1) if mttrs else None,
+        },
+        # Wedge detection is bounded by the heartbeat machinery
+        # (~2x interval + sweep); kill detection additionally waits
+        # out the reconnect grace window (a closed socket might be a
+        # transient drop) — two different protocol bounds.
+        "detect_ms": {
+            "wedge_p50": round(1e3 * _percentile(detects["wedge"], 50),
+                               1) if detects["wedge"] else None,
+            "wedge_max": round(1e3 * max(detects["wedge"]), 1)
+            if detects["wedge"] else None,
+            "wedge_bound_ms": round(1e3 * 2 * interval, 1),
+            "kill_p50": round(1e3 * _percentile(detects["kill"], 50),
+                              1) if detects["kill"] else None,
+            "kill_max": round(1e3 * max(detects["kill"]), 1)
+            if detects["kill"] else None,
+            # grace window + EOF-notice poll + expiry sweep
+            "kill_bound_ms": round(1e3 * (2 * interval + interval), 1),
+        },
+        "restore_ms_p50": round(1e3 * _percentile(restores, 50), 2)
+        if restores else None,
+        "replay_reengaged_all": all(c.get("replay_reengaged")
+                                    for c in cells),
+        "transient_drop": {
+            "ok": drop.get("ok"),
+            "reconnects_resumed": drop.get("reconnects_resumed"),
+            "fatal_events": drop.get("fatal_events"),
+        },
+        "metrics": {
+            "hvd_recovery_seconds": snap.get("histograms", {}).get(
+                "hvd_recovery_seconds"),
+            "hvd_reconnects_total": snap.get("counters", {}).get(
+                "hvd_reconnects_total"),
+            "hvd_liveness_timeouts_total": snap.get(
+                "counters", {}).get("hvd_liveness_timeouts_total"),
+        },
+    }
+
+
+def check_recovery_regression(out: dict, repo_dir: str):
+    """MTTR is a regression-gated bench number like the smoke
+    headline: warn (stderr + artifact field) when the p50 MTTR grew
+    beyond the noise band vs the prior round's artifact, or when any
+    drill cell failed outright."""
+    import glob
+    import re
+    cur = out.get("recovery") or {}
+    if not cur or "error" in cur:
+        return
+    if not cur.get("cells_ok"):
+        print("WARNING: recovery drill cells failed — the self-healing "
+              "control plane is broken, not just slow", file=sys.stderr)
+    cur_mttr = (cur.get("mttr_ms") or {}).get("p50")
+    if cur_mttr is None:
+        return
+    prior = None
+    for path in reversed(sorted(glob.glob(
+            os.path.join(repo_dir, "BENCH_r*.json")))):
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        m = re.search(
+            r'\\?"recovery\\?":\s*\{.*?"mttr_ms\\?":\s*\{[^}]*?"p50'
+            r'\\?":\s*(-?[0-9.]+)', raw, re.S)
+        if m and float(m.group(1)) > 0:
+            prior = {"mttr_p50_ms": float(m.group(1)),
+                     "source": os.path.basename(path)}
+            break
+    if prior is None:
+        return  # first round with a recovery lane
+    tol_pct = 30.0  # wall-clock drill on a shared CPU: wide noise band
+    delta_pct = (cur_mttr - prior["mttr_p50_ms"]) \
+        / prior["mttr_p50_ms"] * 100.0
+    cur["recovery_vs_prior"] = {
+        "prior_mttr_p50_ms": prior["mttr_p50_ms"],
+        "prior_source": prior["source"],
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": tol_pct,
+        "regressed": delta_pct > tol_pct,
+    }
+    if cur["recovery_vs_prior"]["regressed"]:
+        print("WARNING: p50 MTTR regressed %.1f%% vs %s "
+              "(%.0f ms -> %.0f ms), beyond the %.0f%% noise band"
+              % (delta_pct, prior["source"], prior["mttr_p50_ms"],
+                 cur_mttr, tol_pct), file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
 # Eager allreduce micro-benchmark (2 real processes, real control plane)
 # ---------------------------------------------------------------------------
 
@@ -1193,7 +1328,8 @@ def main():
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
-                        "collectives", "checkpoint", "scale"],
+                        "collectives", "checkpoint", "scale",
+                        "recovery"],
                    default=None)
     args = p.parse_args()
 
@@ -1247,7 +1383,7 @@ def main():
 
     run = {args.only} if args.only else {"resnet", "bert", "keras",
                                      "collectives", "checkpoint",
-                                     "scale"}
+                                     "scale", "recovery"}
 
     resnet = {}
     if "resnet" in run:
@@ -1303,6 +1439,13 @@ def main():
             out["scale_eager"] = bench_scale(args, args.smoke)
         except Exception as e:
             out["scale_eager"] = {"error": repr(e)[:300]}
+    if "recovery" in run:
+        try:
+            out["recovery"] = bench_recovery(args, args.smoke)
+        except Exception as e:
+            out["recovery"] = {"error": repr(e)[:300]}
+        check_recovery_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:
         check_smoke_regression(

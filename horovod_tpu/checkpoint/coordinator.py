@@ -28,11 +28,24 @@ import time
 from typing import Dict, List, Optional
 
 from ..common import failpoints as _fp
+from ..common import metrics
 
 logger = logging.getLogger("horovod_tpu.checkpoint")
 
 SCOPE = "ckpt"
 KEY_LATEST = "latest"
+
+_KV_ERRORS = metrics.counter(
+    "hvd_ckpt_kv_errors_total",
+    "Rendezvous-KV request failures in checkpoint commit coordination "
+    "(a climbing counter means the rendezvous is down and two-phase "
+    "commit is degrading, not just slow)")
+
+# A gather tolerates this many CONSECUTIVE failed polls (with backoff)
+# before abandoning the step early: a dead rendezvous must surface as
+# an abandoned commit + warning, never as a silent stall to the
+# deadline.
+_KV_ERROR_CAP = 20
 
 
 class CommitCoordinator:
@@ -136,7 +149,10 @@ class KVCommitCoordinator(CommitCoordinator):
                ) -> Optional[List[dict]]:
         deadline = time.monotonic() + timeout
         marks: Dict[int, dict] = {}
+        consecutive_errors = 0
+        warned = False
         while True:
+            poll_errored = False
             for rank in range(world_size):
                 if rank in marks:
                     continue
@@ -144,7 +160,23 @@ class KVCommitCoordinator(CommitCoordinator):
                     raw = self._client.get(SCOPE,
                                            self._prep_key(step, rank))
                 except OSError:
-                    raw = None  # transient; retry next poll
+                    # Transient reads ride the poll loop, but NOT
+                    # unboundedly: count them, warn once, back off,
+                    # and abandon the step early when the rendezvous
+                    # looks dead (pre-fix this was a silent
+                    # `raw = None` that stalled two-phase commit
+                    # invisibly until the deadline).
+                    _KV_ERRORS.inc(1, op="gather")
+                    poll_errored = True
+                    if not warned:
+                        warned = True
+                        logger.warning(
+                            "ckpt: rendezvous KV read failed during "
+                            "commit gather at step %d (will retry "
+                            "with backoff, cap %d consecutive "
+                            "errors)", step, _KV_ERROR_CAP,
+                            exc_info=True)
+                    raw = None
                 if raw is not None:
                     try:
                         marks[rank] = json.loads(raw.decode())
@@ -154,12 +186,27 @@ class KVCommitCoordinator(CommitCoordinator):
                                        rank)
             if len(marks) >= world_size:
                 return [marks[r] for r in sorted(marks)]
+            if poll_errored:
+                consecutive_errors += 1
+                if consecutive_errors >= _KV_ERROR_CAP:
+                    logger.error(
+                        "ckpt: rendezvous KV unreachable for %d "
+                        "consecutive polls; abandoning commit gather "
+                        "at step %d (have ranks %s of %d)",
+                        consecutive_errors, step, sorted(marks),
+                        world_size)
+                    return None
+            else:
+                consecutive_errors = 0
             if time.monotonic() >= deadline:
                 logger.warning(
                     "ckpt commit gather timed out at step %d: have "
                     "ranks %s of %d", step, sorted(marks), world_size)
                 return None
-            time.sleep(self._poll)
+            # Exponential backoff while the KV is erroring, capped so
+            # recovery after a blip is still prompt.
+            time.sleep(min(self._poll * (2 ** consecutive_errors),
+                           2.0) if consecutive_errors else self._poll)
 
     def mark_committed(self, step: int):
         try:
@@ -167,6 +214,7 @@ class KVCommitCoordinator(CommitCoordinator):
         except OSError:
             # Non-fatal: the manifest on disk is the durable truth;
             # the KV mark only accelerates peers/driver discovery.
+            _KV_ERRORS.inc(1, op="mark_committed")
             logger.warning("ckpt: failed to publish committed step %d "
                            "to the rendezvous KV", step)
 
@@ -174,6 +222,7 @@ class KVCommitCoordinator(CommitCoordinator):
         try:
             raw = self._client.get(SCOPE, KEY_LATEST)
         except OSError:
+            _KV_ERRORS.inc(1, op="committed_step")
             return None
         if raw is None:
             return None

@@ -237,8 +237,7 @@ def init(comm=None, process_sets=None):
             eps = resolve_endpoints(
                 client, state.rank_info.rank,
                 os.environ["HOROVOD_RANK0_ADDR"], STATIC_KEY,
-                timeout=float(os.environ.get("HOROVOD_START_TIMEOUT",
-                                             600)))
+                timeout=env_mod.start_timeout())
             os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = \
                 eps["coordinator"]
             os.environ["HOROVOD_CONTROLLER_ADDR"] = \

@@ -23,12 +23,15 @@ import json
 import logging
 import os
 import queue
+import random
 import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
+from . import env as env_mod
 from . import failpoints as _fp
 from . import metrics
 from .controller import Controller, MessageTable, construct_response
@@ -56,6 +59,16 @@ _MAGIC_PARAMS = b"PA"   # coord→worker: autotuned runtime parameters
 _MAGIC_ABORT = b"AB"    # coord→worker: membership broken, fail fast
 _MAGIC_METRICS_REQ = b"MQ"  # coord→worker: send a metrics snapshot
 _MAGIC_METRICS_REP = b"MR"  # worker→coord: metrics snapshot (JSON)
+_MAGIC_HB = b"HB"       # both ways: liveness heartbeat (empty payload)
+_MAGIC_WELCOME = b"WE"  # coord→worker: reconnect handshake answer
+
+# Per-link replay buffers for the reconnecting control channel: each
+# side keeps its last N stream frames so a link that drops and resumes
+# inside the grace window replays exactly the frames the peer missed
+# (TCP ordering makes the frame ordinal an implicit sequence number —
+# no wire-format change).  A resume point older than the buffer is
+# unrecoverable and promotes the rank to lost.
+_LINK_LOG_FRAMES = 512
 
 _FRAMES_SENT = metrics.counter(
     "hvd_frames_sent_total", "Control-plane frames sent, by kind")
@@ -82,6 +95,19 @@ _UPLINK_BATCH = metrics.histogram(
     "Requests/bits coalesced into one uplink frame, by kind (drain-"
     "all-pending coalescing: frame count tracks batch count, not "
     "tensor count)", bounds=metrics.COUNT_BUCKETS)
+_HEARTBEATS = metrics.counter(
+    "hvd_liveness_heartbeats_total",
+    "HB liveness frames sent, by role (suppressed while real traffic "
+    "flows, so steady-state training sends none)")
+_LIVENESS_TIMEOUTS = metrics.counter(
+    "hvd_liveness_timeouts_total",
+    "Peers promoted to dead by the liveness machinery, by role and "
+    "kind (coordinator: silent rank; worker: silent coordinator)")
+_RECONNECTS = metrics.counter(
+    "hvd_reconnects_total",
+    "Control-channel reconnect outcomes (resumed = session replayed "
+    "transparently; failed = worker gave up; refused = coordinator "
+    "could not replay; expired = coordinator grace window ran out)")
 
 
 def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
@@ -109,6 +135,61 @@ def _recv_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes]]:
     return magic, payload
 
 
+class _LinkSilent(Exception):
+    """Raised by a bounded recv's idle callback: the peer has been
+    silent past the liveness deadline (the link may still be open —
+    SIGSTOP, GIL deadlock, half-open socket)."""
+
+
+def _recv_exact_bounded(sock: socket.socket, n: int, on_idle,
+                        on_data=None):
+    """`_recv_exact` for a socket with a poll timeout set: every
+    timeout expiry calls ``on_idle()`` — which raises to abort the
+    wait — so no control-plane recv can block forever.  ``on_data``
+    fires on every received chunk so a large frame trickling in slower
+    than the liveness timeout still counts as a live peer."""
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            on_idle()
+            continue
+        if not chunk:
+            return None
+        if on_data is not None:
+            on_data()
+        buf += chunk
+    return buf
+
+
+def _recv_frame_bounded(sock: socket.socket, on_idle, on_data=None
+                        ) -> Optional[Tuple[bytes, bytes]]:
+    head = _recv_exact_bounded(sock, 6, on_idle, on_data)
+    if head is None:
+        return None
+    magic, ln = head[:2], struct.unpack("<I", head[2:])[0]
+    payload = _recv_exact_bounded(sock, ln, on_idle, on_data)
+    if payload is None:
+        return None
+    return magic, payload
+
+
+def _parse_registration(payload: bytes) -> Tuple[int, dict]:
+    """Registration frame payload: 4-byte rank, optionally followed by
+    a JSON session blob (reconnecting-channel handshake).  The plain
+    4-byte form remains valid — and is all the native coordinator ever
+    sees (it reads the first 4 bytes and ignores the rest)."""
+    rank = struct.unpack("<i", payload[:4])[0]
+    session = {}
+    if len(payload) > 4:
+        try:
+            session = json.loads(payload[4:].decode())
+        except (ValueError, UnicodeDecodeError):
+            session = {}
+    return rank, session
+
+
 class CoordinatorServer:
     """Rank-0 service: accepts one connection per rank (including a
     loopback connection from rank 0's own worker), matches requests,
@@ -121,7 +202,12 @@ class CoordinatorServer:
                  param_manager=None, cache_capacity: int = 1024,
                  stall_warning_time_s: float = 60.0,
                  stall_shutdown_time_s: float = 0.0,
-                 metrics_interval_s: float = 0.0):
+                 metrics_interval_s: float = 0.0,
+                 liveness_interval_s: float = 0.0,
+                 liveness_timeout_s: float = 0.0,
+                 reconnect_grace_s: float = 0.0,
+                 registration_timeout_s: float = 30.0,
+                 on_rank_lost=None):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
@@ -181,6 +267,34 @@ class CoordinatorServer:
         self._started_at = time.monotonic()  # formation-stall clock
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # --- self-healing control plane (docs/failure_recovery.md) ---
+        # Liveness: bounded-time detection of wedged-but-connected
+        # ranks via HB heartbeats + a sweep, with no dependence on a
+        # collective being in flight.  Reconnect: a dead socket parks
+        # the rank in limbo for a grace window; a resume replays the
+        # frames it missed from the per-rank out-log.
+        self.liveness_interval_s = liveness_interval_s
+        self.liveness_timeout_s = liveness_timeout_s or \
+            2.0 * liveness_interval_s
+        self.reconnect_grace_s = reconnect_grace_s
+        self.registration_timeout_s = registration_timeout_s
+        self._on_rank_lost_hook = on_rank_lost
+        self._last_heard: Dict[int, float] = {}
+        self._departure_counted: Set[int] = set()
+        # Per-rank stream lock: frame processing + the _in_count
+        # cursor advance are atomic under it, and the resume handshake
+        # takes it to wait out an in-flight frame — so a frame is
+        # either fully processed (counted, not replayed) or discarded
+        # un-counted (replayed by the worker).  Never both.
+        self._stream_locks: Dict[int, threading.Lock] = {}
+        self._sessions: Dict[int, str] = {}
+        self._conn_gen: Dict[int, int] = {}   # supersession guard
+        self._limbo: Dict[int, float] = {}    # rank -> disconnect time
+        self._lost: Set[int] = set()          # final (idempotence)
+        self._out_log: Dict[int, deque] = {}  # rank -> (ord, magic, pl)
+        self._out_seq: Dict[int, int] = {}    # downlink frames sent
+        self._in_count: Dict[int, int] = {}   # uplink frames processed
+        self._last_broadcast_t = time.monotonic()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -210,6 +324,16 @@ class CoordinatorServer:
                 target=self._stall_loop, name="hvd-coord-stall",
                 daemon=True)
             self._stall_thread.start()
+        # The sweep must also run for grace-only configurations
+        # (liveness off, reconnects on): limbo expiry lives in the
+        # sweep, and without it a permanently dead rank would park in
+        # limbo forever.
+        self._liveness_thread = None
+        if liveness_interval_s > 0 or reconnect_grace_s > 0:
+            self._liveness_thread = threading.Thread(
+                target=self._liveness_loop, name="hvd-coord-liveness",
+                daemon=True)
+            self._liveness_thread.start()
         # --- cross-rank metrics aggregation (MQ/MR frames): collect
         #     per-rank registry snapshots and expose the merged view,
         #     the metrics analog of the rank-0 stall report ---
@@ -234,54 +358,231 @@ class CoordinatorServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # First frame identifies the rank.  Bound the wait so a
             # connected-but-silent client can't stall registration of
-            # the remaining ranks.
-            conn.settimeout(30.0)
+            # the remaining ranks (HOROVOD_REGISTRATION_TIMEOUT).
+            conn.settimeout(self.registration_timeout_s)
             try:
                 frame = _recv_frame(conn)
             except (socket.timeout, OSError):
                 conn.close()
                 continue
-            conn.settimeout(None)
             if frame is None:
                 conn.close()
                 continue
-            rank = struct.unpack("<i", frame[1])[0]
-            with self._lock:
-                self._conns[rank] = conn
-                # Late joiners (elastic re-rendezvous) must start from
-                # the currently announced parameters, and they see the
-                # PA frame before any response frame — the same stream
-                # position every other worker saw it at.
-                if self._synced_params is not None:
-                    try:
-                        _send_frame(conn, _MAGIC_PARAMS,
-                                    self._synced_params)
-                    except OSError:
-                        pass
-                if not self._formed and len(self._conns) >= self.size:
-                    self._formed = True
-                    pre, self._pre_formed = self._pre_formed, []
-                    for kind, r, payload in pre:
-                        self._dispatch_uplink_locked(kind, r, payload)
-            with self._departed_cond:
-                self._seen += 1
-                self._departed_cond.notify_all()
-            t = threading.Thread(target=self._rank_loop, args=(rank, conn),
-                                 name=f"hvd-coord-rank{rank}", daemon=True)
-            t.start()
-            self._threads.append(t)
+            rank, sess = _parse_registration(frame[1])
+            if sess.get("resume"):
+                self._try_resume(rank, sess, conn)
+            else:
+                self._register_fresh(rank, sess, conn)
 
-    def _rank_loop(self, rank: int, conn: socket.socket):
+    def _install_conn_locked(self, rank: int, conn: socket.socket) -> int:
+        """Install ``conn`` as rank's live link (superseding any stale
+        one) and return its link generation — rank-loop exits compare
+        generations so a replaced link's death can't demote a resumed
+        rank (caller holds self._lock)."""
+        old = self._conns.get(rank)
+        if old is not None and old is not conn:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._conns[rank] = conn
+        self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
+        self._stream_locks.setdefault(rank, threading.Lock())
+        self._last_heard[rank] = time.monotonic()
+        if self.liveness_interval_s > 0:
+            # Bounded registered-link recv: the rank loop polls at a
+            # fraction of the liveness timeout instead of blocking in
+            # recv forever (the pre-liveness settimeout(None) hole).
+            conn.settimeout(self._sweep_period())
+        else:
+            conn.settimeout(None)
+        return self._conn_gen[rank]
+
+    def _register_fresh(self, rank: int, sess: dict,
+                        conn: socket.socket):
+        with self._lock:
+            gen = self._install_conn_locked(rank, conn)
+            self._sessions[rank] = sess.get("session", "")
+            self._limbo.pop(rank, None)
+            # A fresh session starts a fresh frame stream.
+            self._out_seq[rank] = 0
+            self._in_count[rank] = 0
+            if self.reconnect_grace_s > 0:
+                self._out_log[rank] = deque(maxlen=_LINK_LOG_FRAMES)
+            # Late joiners (elastic re-rendezvous) must start from
+            # the currently announced parameters, and they see the
+            # PA frame before any response frame — the same stream
+            # position every other worker saw it at.
+            if self._synced_params is not None:
+                self._send_to_rank_locked(rank, _MAGIC_PARAMS,
+                                          self._synced_params)
+            if not self._formed and len(self._conns) >= self.size:
+                self._formed = True
+                pre, self._pre_formed = self._pre_formed, []
+                for kind, r, payload in pre:
+                    self._dispatch_uplink_locked(kind, r, payload)
+        with self._departed_cond:
+            # A fresh session is a new rank life: it gets its own
+            # seen/departed pair (a restarted process re-registering
+            # mid-incarnation must keep the drain arithmetic balanced).
+            self._departure_counted.discard(rank)
+            self._seen += 1
+            self._departed_cond.notify_all()
+        self._spawn_rank_loop(rank, conn, gen)
+
+    def _try_resume(self, rank: int, sess: dict, conn: socket.socket):
+        """Reconnect handshake: same session inside the grace window →
+        replace the link, tell the worker how many of its uplink
+        frames we processed (WE frame), and replay the downlink frames
+        it missed.  Anything else is refused — the worker fails over
+        to the broken-membership path."""
+        with self._lock:
+            recv_count = int(sess.get("recv_count", 0))
+            out_seq = self._out_seq.get(rank, 0)
+            log = self._out_log.get(rank)
+            ok = (self.reconnect_grace_s > 0 and
+                  rank not in self._lost and
+                  sess.get("session") and
+                  sess.get("session") == self._sessions.get(rank) and
+                  log is not None and
+                  0 <= recv_count <= out_seq and
+                  out_seq - recv_count <= len(log))
+            if not ok:
+                logger.warning(
+                    "refusing control-channel resume for rank %d "
+                    "(session %s, recv_count %d/%d, grace %s)", rank,
+                    (sess.get("session") or "?")[:8], recv_count,
+                    out_seq, self.reconnect_grace_s)
+                _RECONNECTS.inc(1, outcome="refused")
+                try:
+                    _send_frame(conn, _MAGIC_WELCOME,
+                                json.dumps({"resume": False}).encode())
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            # Phase 1 (under the lock): supersede the old link — bump
+            # the generation so the old rank loop discards anything it
+            # has not fully processed, and close its socket.  The rank
+            # stays OUT of _conns for now: broadcasts must keep
+            # accumulating in the out-log until the backlog below has
+            # been replayed, or the stream would reorder.
+            old = self._conns.pop(rank, None)
+            self._conn_gen[rank] = gen = \
+                self._conn_gen.get(rank, 0) + 1
+            # Stay in limbo (fresh timestamp) until phase 3: limbo
+            # membership is what keeps broadcasts flowing into the
+            # out-log during the handshake window.
+            self._limbo[rank] = time.monotonic()
+            stream_lock = self._stream_locks.setdefault(
+                rank, threading.Lock())
+        if old is not None and old is not conn:
+            try:
+                old.close()
+            except OSError:
+                pass
+        # Phase 2 (stream lock, no server lock): wait out a frame the
+        # old rank loop may have in flight — once it finishes (and
+        # counts) or gets discarded at its gen check (un-counted, so
+        # the worker's replay re-delivers it), the uplink cursor is
+        # stable and the handshake can quote it.
+        with stream_lock:
+            in_count = self._in_count.get(rank, 0)
+        # Phase 3 (server lock again): install the new conn and send
+        # WE + the missed backlog atomically w.r.t. new broadcasts.
+        with self._lock:
+            if self._conn_gen.get(rank, 0) != gen or \
+                    rank in self._lost or \
+                    self._out_seq.get(rank, 0) - recv_count > len(log):
+                # Superseded by a newer resume, promoted to lost, or
+                # the handshake window pushed the resume point out of
+                # the replay ring — refuse; the worker fails over.
+                logger.warning("control-channel resume for rank %d "
+                               "aborted mid-handshake", rank)
+                _RECONNECTS.inc(1, outcome="refused")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._install_conn_locked(rank, conn)
+            self._limbo.pop(rank, None)
+            try:
+                _send_frame(conn, _MAGIC_WELCOME, json.dumps({
+                    "resume": True,
+                    "recv_count": in_count,
+                }).encode())
+                for ordinal, magic, payload in log:
+                    if ordinal > recv_count:
+                        _send_frame(conn, magic, payload)
+            except OSError:
+                # The fresh link died mid-handshake: back to limbo;
+                # the worker will retry within the grace window.
+                self._enter_limbo_locked(rank)
+                return
+            gen = self._conn_gen[rank]
+        logger.info("rank %d control channel resumed (replayed %d "
+                    "downlink frames)", rank, out_seq - recv_count)
+        _RECONNECTS.inc(1, outcome="resumed")
+        self._spawn_rank_loop(rank, conn, gen)
+
+    def _spawn_rank_loop(self, rank: int, conn: socket.socket,
+                         gen: Optional[int] = None):
+        if gen is None:
+            gen = self._conn_gen.get(rank, 0)
+        t = threading.Thread(target=self._rank_loop,
+                             args=(rank, conn, gen),
+                             name=f"hvd-coord-rank{rank}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _sweep_period(self) -> float:
+        base = self.liveness_interval_s / 2.0 if \
+            self.liveness_interval_s > 0 else self.reconnect_grace_s / 4.0
+        return max(min(base, 1.0), 0.05)
+
+    def _rank_loop(self, rank: int, conn: socket.socket, gen: int = 0):
         clean = False
+        silent = False
+
+        def on_idle():
+            # Poll-timeout expiry on the registered link: give up once
+            # the peer has been silent past the liveness deadline (a
+            # wedged rank holds its socket open — only the HB cadence
+            # can expose it).
+            if self._stop.is_set() or \
+                    self._conn_gen.get(rank, 0) != gen:
+                raise _LinkSilent("superseded")
+            if time.monotonic() - self._last_heard.get(rank, 0.0) \
+                    > self.liveness_timeout_s:
+                raise _LinkSilent(
+                    "rank %d silent for > %.1fs" %
+                    (rank, self.liveness_timeout_s))
+
+        def on_data():
+            self._last_heard[rank] = time.monotonic()
+
+        bounded = self.liveness_interval_s > 0
         try:
             while not self._stop.is_set():
                 try:
-                    frame = _recv_frame(conn)
+                    if bounded:
+                        frame = _recv_frame_bounded(conn, on_idle,
+                                                    on_data)
+                    else:
+                        frame = _recv_frame(conn)
                 except OSError:
                     frame = None
+                except _LinkSilent as e:
+                    if str(e) != "superseded":
+                        logger.warning("liveness: %s; promoting to "
+                                       "lost", e)
+                        silent = True
+                    return
                 if frame is None:
                     return
                 magic, payload = frame
+                self._last_heard[rank] = time.monotonic()
                 # Failpoint site: uplink frame arrival on the
                 # coordinator.  drop() discards the frame (the sender's
                 # tensor goes incomplete — the stall machinery must
@@ -290,27 +591,208 @@ class CoordinatorServer:
                 if _fp.ENABLED and \
                         _fp.maybe_fail("coord.frame_recv",
                                        rank=rank) == "drop":
+                    # An injected drop still counts as processed (the
+                    # frame was lost, not deferred) — under the stream
+                    # lock like the real handling below.
+                    lock = self._stream_locks.get(rank)
+                    if lock is not None:
+                        with lock:
+                            if self._conn_gen.get(rank, 0) != gen:
+                                return
+                            self._in_count[rank] = \
+                                self._in_count.get(rank, 0) + 1
                     continue
                 _FRAMES_RECV.inc(1, kind=magic.decode("ascii",
                                                       "replace"))
                 _BYTES_RECV.inc(len(payload) + 6)
-                if magic == _MAGIC_HITS:
-                    self._handle_cache_hits(rank, unpack_bits(payload))
-                    continue
-                if magic == _MAGIC_METRICS_REP:
-                    self._handle_metrics_snapshot(rank, payload)
-                    continue
-                requests, shutdown = unpack_request_list(payload)
-                if shutdown:
-                    clean = True
+                # Frame handling + the stream-cursor advance are one
+                # atomic unit under the per-rank stream lock: the
+                # resume handshake takes the same lock to quote a
+                # stable _in_count, and the generation check makes a
+                # superseded loop DISCARD its in-hand frame un-counted
+                # (the worker's uplink replay re-delivers it) — a
+                # frame is processed exactly once, by exactly one
+                # link generation.
+                stream_lock = self._stream_locks.get(rank)
+                if stream_lock is None:
                     return
-                self._handle_requests(rank, requests)
+                with stream_lock:
+                    if self._conn_gen.get(rank, 0) != gen:
+                        return  # superseded mid-stream
+                    try:
+                        if magic == _MAGIC_HB:
+                            continue  # pure liveness signal
+                        if magic == _MAGIC_HITS:
+                            self._handle_cache_hits(
+                                rank, unpack_bits(payload))
+                            continue
+                        if magic == _MAGIC_METRICS_REP:
+                            self._handle_metrics_snapshot(rank,
+                                                          payload)
+                            continue
+                        requests, shutdown = \
+                            unpack_request_list(payload)
+                        if shutdown:
+                            clean = True
+                            return
+                        self._handle_requests(rank, requests)
+                    finally:
+                        # Stream cursor for the reconnect handshake:
+                        # a frame counts once fully handled, so a
+                        # resume replays exactly the unprocessed tail.
+                        self._in_count[rank] = \
+                            self._in_count.get(rank, 0) + 1
         finally:
-            with self._departed_cond:
-                self._departed += 1
-                self._departed_cond.notify_all()
-            if not self._stop.is_set():
-                self._on_rank_lost(rank, clean)
+            self._rank_link_down(rank, gen, clean, silent)
+
+    def _rank_link_down(self, rank: int, gen: int, clean: bool,
+                        silent: bool):
+        """A rank loop exited.  Decide: superseded link (ignore), clean
+        departure, transient disconnect (limbo + grace window), or
+        final loss."""
+        with self._lock:
+            if self._conn_gen.get(rank, 0) != gen:
+                return  # a resumed link took over; nothing departed
+            stopped = self._stop.is_set()
+            limbo = (not stopped and not clean and not silent and
+                     rank not in self._lost and
+                     self.reconnect_grace_s > 0)
+            if limbo:
+                # Socket death with reconnects enabled: hold the rank
+                # in limbo — a transient TCP drop comes back within
+                # the grace window and nobody else ever knows.  Its
+                # departure is deferred to resume-or-expire.
+                self._enter_limbo_locked(rank)
+        if limbo:
+            return
+        self._count_departed(rank)
+        if not stopped:
+            self._promote_lost(rank, clean,
+                               reason="liveness timeout" if silent
+                               else None)
+
+    def _promote_lost(self, rank: int, clean: bool,
+                      reason: Optional[str] = None) -> bool:
+        """Final, idempotent rank-loss transition: every detector
+        (rank-loop exit, liveness sweep, grace expiry) funnels here;
+        only the first caller runs the broken-membership machinery."""
+        with self._lock:
+            if rank in self._lost:
+                return False
+            self._lost.add(rank)
+            self._limbo.pop(rank, None)
+            conn = self._conns.get(rank)
+        if reason == "liveness timeout":
+            _LIVENESS_TIMEOUTS.inc(1, role="coordinator")
+        if conn is not None:
+            try:
+                conn.close()  # unblocks a rank loop stuck in recv
+            except OSError:
+                pass
+        self._on_rank_lost(rank, clean, reason)
+        return True
+
+    def _count_departed(self, rank: int):
+        """At most ONE departure per rank life: several detectors can
+        observe the same death (rank-loop exit, grace expiry after a
+        send-failure limbo, the sweep) and an over-count would let the
+        drain tear the coordinator down under still-attached ranks."""
+        with self._departed_cond:
+            if rank in self._departure_counted:
+                return
+            self._departure_counted.add(rank)
+            self._departed += 1
+            self._departed_cond.notify_all()
+
+    def _enter_limbo_locked(self, rank: int):
+        if rank in self._limbo or rank in self._lost:
+            return
+        self._conns.pop(rank, None)
+        self._limbo[rank] = time.monotonic()
+        logger.info("rank %d control link dropped; holding in limbo "
+                    "for %.1fs grace", rank, self.reconnect_grace_s)
+
+    # ------------------------------------------------------------------
+    # liveness sweep
+    # ------------------------------------------------------------------
+    def _liveness_loop(self):
+        """Coordinator half of bounded-time liveness: broadcast HB
+        when the downlink has been idle (so workers can bound their
+        own recv waits), promote silent ranks and expired limbo ranks
+        to lost, and bound the formation wait by the start timeout."""
+        period = self._sweep_period()
+        hb_armed = self.liveness_interval_s > 0
+        while not self._stop.wait(period):
+            now = time.monotonic()
+            with self._lock:
+                silent = []
+                if hb_armed:
+                    if now - self._last_broadcast_t >= \
+                            self.liveness_interval_s:
+                        self._broadcast_frame_locked(_MAGIC_HB, b"")
+                        _HEARTBEATS.inc(1, role="coordinator")
+                    silent = [r for r, t in self._last_heard.items()
+                              if r in self._conns and
+                              now - t > self.liveness_timeout_s]
+                expired = [r for r, t in self._limbo.items()
+                           if now - t > self.reconnect_grace_s]
+            for rank in silent:
+                if self._promote_lost(rank, clean=False,
+                                      reason="liveness timeout"):
+                    logger.warning(
+                        "liveness: rank %d silent for > %.1fs; "
+                        "promoted to lost", rank,
+                        self.liveness_timeout_s)
+            for rank in expired:
+                if self._promote_lost(rank, clean=False,
+                                      reason="reconnect grace "
+                                             "expired"):
+                    logger.warning(
+                        "rank %d did not reconnect within the %.1fs "
+                        "grace window; promoted to lost", rank,
+                        self.reconnect_grace_s)
+                    _RECONNECTS.inc(1, outcome="expired")
+                    # Usually its rank loop already exited into limbo
+                    # without counting a departure; when limbo was
+                    # entered from a send failure the loop is still
+                    # alive and will try to count again — the per-rank
+                    # dedup makes either order count exactly once.
+                    self._count_departed(rank)
+            # Formation deadline: pre-formation there may be no stall
+            # machinery armed at all — bound the wait for stragglers
+            # by the start timeout so a job missing a rank fails
+            # crisply instead of hanging.
+            if not self._formed and \
+                    now - self._started_at > env_mod.start_timeout():
+                self._fail_formation_locked_entry()
+
+    def _fail_formation_locked_entry(self):
+        with self._lock:
+            if self._formed:
+                return
+            missing = sorted(set(range(self.size)) -
+                             set(self._conns.keys()))
+            # Log once even with nothing buffered: an idle formation
+            # hang past the deadline must leave a trace (the sweep
+            # re-evaluates every period).
+            if ("__formation_deadline__",) not in self._stall_logged:
+                self._stall_logged[("__formation_deadline__",)] = 1.0
+                logger.error(
+                    "formation deadline: ranks %s never connected "
+                    "within the %.0fs start timeout", missing,
+                    env_mod.start_timeout())
+            pre, self._pre_formed = self._pre_formed, []
+            errs = [Response(
+                response_type=ResponseType.ERROR,
+                tensor_names=[req.tensor_name],
+                process_set_id=req.process_set_id,
+                error_message=(
+                    "ranks %s never connected within the %.0fs start "
+                    "timeout" % (missing, env_mod.start_timeout())))
+                for kind, _, payload in pre if kind == "rq"
+                for req in payload]
+            if errs:
+                self._broadcast_locked(errs)
 
     def departure_counts(self):
         """(ever_connected, departed) rank-connection counters."""
@@ -352,13 +834,22 @@ class CoordinatorServer:
         merged["ranks"] = sorted(snaps)
         return merged
 
-    def _on_rank_lost(self, rank: int, clean: bool):
+    def _on_rank_lost(self, rank: int, clean: bool,
+                      reason: Optional[str] = None):
         """A rank departed mid-run.  In elastic mode, pending
         negotiations can never complete: fail them on every surviving
         rank so blocked synchronize() calls raise HorovodInternalError
         and unwind to the elastic retry loop (the analog of the
         reference's collective errors on peer failure,
         common/exceptions.py:18 semantics)."""
+        if self._on_rank_lost_hook is not None:
+            # Out-of-band notification (rank 0 publishes it to the
+            # elastic rendezvous KV so the driver can evict the host
+            # of a wedged-but-alive worker process).
+            try:
+                self._on_rank_lost_hook(rank, clean, reason)
+            except Exception:
+                logger.warning("rank-lost hook failed", exc_info=True)
         with self._lock:
             # A departed rank must stop contributing to the merged
             # metrics view: its frozen last snapshot would otherwise be
@@ -388,7 +879,7 @@ class CoordinatorServer:
             self._first_seen.clear()
             self._bit_only.clear()
             msg = (f"rank {rank} left the job "
-                   f"({'clean' if clean else 'connection lost'}); "
+                   f"({'clean' if clean else reason or 'connection lost'}); "
                    "membership changed")
             logger.info("elastic coordinator: %s", msg)
             responses = [Response(
@@ -755,21 +1246,64 @@ class CoordinatorServer:
                 logger.warning("failpoint coord.broadcast: injected "
                                "error; dropping the frame")
                 return
-        dead = []
-        for r, conn in self._conns.items():
-            try:
-                _send_frame(conn, magic, payload)
-            except OSError:
-                dead.append(r)
-        for r in dead:
-            self._conns.pop(r, None)
-        sent = len(self._conns)
+        self._last_broadcast_t = time.monotonic()
+        sent = 0
+        if self.reconnect_grace_s > 0:
+            # Limbo ranks have no live socket but stay in the fan-out:
+            # the frame enters their out-log, so a resume inside the
+            # grace window replays it and the rank never falls out of
+            # lockstep.
+            for r in list(self._conns.keys()) + \
+                    list(self._limbo.keys()):
+                if self._send_to_rank_locked(r, magic, payload):
+                    sent += 1
+        else:
+            # Reconnects off: the original direct fan-out (this is the
+            # hottest coordinator path — no per-rank indirection).
+            dead = []
+            for r, conn in self._conns.items():
+                try:
+                    _send_frame(conn, magic, payload)
+                    sent += 1
+                except OSError:
+                    dead.append(r)
+            for r in dead:
+                self._conns.pop(r, None)
         if sent:
             # Coordinator fan-out is the dominant control-plane send
             # volume on rank 0 — account it next to the worker-side
             # counters (same registry, same process).
             _FRAMES_SENT.inc(sent, kind=magic.decode("ascii", "replace"))
             _BYTES_SENT.inc(sent * (len(payload) + 6))
+
+    def _send_to_rank_locked(self, rank: int, magic: bytes,
+                             payload: bytes) -> bool:
+        """One downlink frame to one rank: out-log bookkeeping and the
+        send in lockstep (caller holds self._lock).  A send failure
+        with reconnects enabled parks the rank in limbo instead of
+        dropping it."""
+        self._log_out_locked(rank, magic, payload)
+        conn = self._conns.get(rank)
+        if conn is None:
+            return False
+        try:
+            _send_frame(conn, magic, payload)
+            return True
+        except OSError:
+            if self.reconnect_grace_s > 0 and rank not in self._lost:
+                self._enter_limbo_locked(rank)
+            else:
+                self._conns.pop(rank, None)
+            return False
+
+    def _log_out_locked(self, rank: int, magic: bytes, payload: bytes):
+        if self.reconnect_grace_s <= 0:
+            return
+        log = self._out_log.get(rank)
+        if log is None:
+            return
+        self._out_seq[rank] = self._out_seq.get(rank, 0) + 1
+        log.append((self._out_seq[rank], magic, payload))
 
     # ------------------------------------------------------------------
     # stall attribution (reference stall_inspector.{h,cc}: rank-0 names
@@ -923,6 +1457,27 @@ class NetworkController(Controller):
         # flight; written only by the recv thread.
         self._mr_sending = False
         self._replay_observer = None
+        # --- self-healing control plane (docs/failure_recovery.md) ---
+        # _selfheal is THE hot-path gate: None when both liveness and
+        # reconnect are disabled, so the steady-state submit path pays
+        # exactly one attribute check (the failpoints.ENABLED
+        # precedent, asserted by tests/test_liveness.py).
+        knobs = state.knobs
+        self._liveness_interval_s = knobs.liveness_interval_s
+        self._liveness_timeout_s = knobs.liveness_timeout_s
+        self._grace_s = knobs.reconnect_grace_s
+        self._selfheal = True if (self._liveness_interval_s > 0 or
+                                  self._grace_s > 0) else None
+        self._session_id = "%016x" % random.getrandbits(64)
+        self._up_log: deque = deque(maxlen=_LINK_LOG_FRAMES)
+        self._up_count = 0          # uplink frames sent this session
+        self._recv_count = 0        # downlink frames processed
+        self._last_recv_t = time.monotonic()
+        self._last_uplink_t = time.monotonic()
+        self._wedged = False        # harness SIGSTOP analog
+        self._half_open = False     # harness peer-vanishes analog
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
         addr = os.environ.get(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
@@ -962,10 +1517,15 @@ class NetworkController(Controller):
         self._recv_buf: "queue.Queue" = queue.Queue()
         self._on_receive = None
         self._on_response = None
+        self._send_lock = threading.Lock()
         self._recv_thread = threading.Thread(
             target=self._recv_loop, name="hvd-ctrl-recv", daemon=True)
         self._recv_thread.start()
-        self._send_lock = threading.Lock()
+        if self._liveness_interval_s > 0:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="hvd-ctrl-heartbeat",
+                daemon=True)
+            self._hb_thread.start()
 
     def set_receive_callback(self, fn):
         """Called (from the recv thread) whenever a frame is queued —
@@ -1036,8 +1596,22 @@ class NetworkController(Controller):
                 "HOROVOD_TPU_NATIVE=1 is incompatible with "
                 "HOROVOD_FAILPOINTS: fault injection requires the "
                 "Python coordinator.  Unset one of the two.")
+        # The self-healing control plane (HB liveness, reconnect grace)
+        # is Python-coordinator-only: the native server treats any
+        # non-CH/RQ frame as a departed rank, so heartbeats would kill
+        # every link.  Same gating rule as the other Python-only
+        # features above (documented in docs/failure_recovery.md).
+        selfheal = state.knobs.liveness_interval_s > 0 or \
+            state.knobs.reconnect_grace_s > 0
+        if strict_native and selfheal:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_LIVENESS_INTERVAL/HOROVOD_RECONNECT_GRACE: "
+                "the self-healing control plane requires the Python "
+                "coordinator (HB/WE frames).  Unset one of the two.")
         if state.timeline is None and param_manager is None and \
-                metrics_interval <= 0 and not _fp.ENABLED:
+                metrics_interval <= 0 and not _fp.ENABLED and \
+                not selfheal:
             try:
                 from ..native import NativeCoordinatorServer, available
                 if strict_native and not available():
@@ -1072,7 +1646,46 @@ class NetworkController(Controller):
             cache_capacity=state.knobs.cache_capacity,
             stall_warning_time_s=stall_warn,
             stall_shutdown_time_s=state.knobs.stall_shutdown_time_s,
-            metrics_interval_s=metrics_interval)
+            metrics_interval_s=metrics_interval,
+            liveness_interval_s=state.knobs.liveness_interval_s,
+            liveness_timeout_s=state.knobs.liveness_timeout_s,
+            reconnect_grace_s=state.knobs.reconnect_grace_s,
+            registration_timeout_s=state.knobs.registration_timeout_s,
+            on_rank_lost=self._make_rank_lost_publisher(state))
+
+    def _make_rank_lost_publisher(self, state):
+        """Rank-0 hook: publish non-clean rank-lost promotions to the
+        elastic rendezvous KV so the driver can evict the host of a
+        wedged-but-alive worker process (its monitor would otherwise
+        wait forever for an exit code)."""
+        if not state.knobs.elastic:
+            return None
+        client = self._rendezvous_client()
+        if client is None:
+            return None
+
+        def hook(rank, clean, reason, _client=client):
+            if clean:
+                return
+            try:
+                from ..runner.elastic.worker import current_epoch
+                epoch = current_epoch()
+            except Exception:
+                epoch = 0
+            try:
+                # Per-rank key: two ranks lost in the same driver poll
+                # interval must not overwrite each other's notice.
+                _client.put("elastic", "lost-%d" % rank, json.dumps({
+                    "rank": rank,
+                    "reason": reason or "connection lost",
+                    "epoch": epoch,
+                }).encode())
+            except OSError:
+                logger.warning("could not publish the lost-rank "
+                               "notice to the rendezvous KV",
+                               exc_info=True)
+
+        return hook
 
     @staticmethod
     def _rendezvous_client():
@@ -1108,8 +1721,7 @@ class NetworkController(Controller):
         to the env contract (used when no rendezvous server exists)."""
         client = self._rendezvous_client()
         if client is not None:
-            timeout_s = float(os.environ.get("HOROVOD_START_TIMEOUT",
-                                             120))
+            timeout_s = env_mod.start_timeout()
             try:
                 raw = client.wait_get(self._ctrl_scope(), "addr",
                                       timeout=timeout_s)
@@ -1119,25 +1731,204 @@ class NetworkController(Controller):
                                "failed; using env value")
         return env_addr
 
+    def _registration_payload(self, resume: bool) -> bytes:
+        """Rank id, plus the session blob when the self-healing channel
+        is on.  The native coordinator reads only the first 4 bytes, so
+        the extended form stays wire-compatible."""
+        head = struct.pack("<i", self.rank)
+        if self._selfheal is None:
+            return head
+        return head + json.dumps({
+            "session": self._session_id,
+            "resume": resume,
+            "recv_count": self._recv_count,
+        }).encode()
+
+    def _poll_period_s(self) -> float:
+        return max(min(self._liveness_timeout_s / 4.0, 1.0), 0.05)
+
+    def _arm_sock(self, s: socket.socket):
+        """Recv deadline: with liveness on, the recv loop polls at a
+        fraction of the liveness timeout (the pre-liveness
+        settimeout(None) blocked forever on a wedged coordinator)."""
+        if self._liveness_interval_s > 0:
+            s.settimeout(self._poll_period_s())
+        else:
+            s.settimeout(None)
+
     def _connect(self) -> socket.socket:
-        # HOROVOD_START_TIMEOUT bounds the wait for the coordinator to
+        # The start timeout bounds the wait for the coordinator to
         # come up (launcher --start-timeout; reference launch.py
         # start_timeout contract).
-        timeout_s = float(os.environ.get("HOROVOD_START_TIMEOUT", 120))
+        timeout_s = env_mod.start_timeout()
         deadline = time.monotonic() + timeout_s
         last_err = None
         while time.monotonic() < deadline:
             try:
                 s = socket.create_connection(self._addr, timeout=5.0)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(None)
-                _send_frame(s, _MAGIC_REQ, struct.pack("<i", self.rank))
+                self._arm_sock(s)
+                _send_frame(s, _MAGIC_REQ,
+                            self._registration_payload(resume=False))
+                self._last_recv_t = time.monotonic()
                 return s
             except OSError as e:
                 last_err = e
                 time.sleep(0.2)
         raise ConnectionError(
             f"could not reach coordinator at {self._addr}: {last_err}")
+
+    def _reconnect(self) -> bool:
+        """The control socket died mid-incarnation: retry with
+        jittered exponential backoff inside the grace window, resume
+        the session (coordinator replays the downlink we missed, we
+        replay the uplink it never processed), and hand the new socket
+        back to the recv loop.  Returns False when the window expires
+        or the coordinator refuses the resume — the caller then runs
+        the legacy broken-membership path."""
+        deadline = time.monotonic() + self._grace_s
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        attempt = 0
+        while not self._closing:
+            attempt += 1
+            backoff = min(0.05 * (2 ** (attempt - 1)), 1.0)
+            backoff *= 0.5 + random.random()  # jitter: avoid stampede
+            if time.monotonic() + backoff >= deadline:
+                break
+            time.sleep(backoff)
+            try:
+                s = socket.create_connection(self._addr, timeout=2.0)
+            except OSError:
+                continue
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(max(self._grace_s, 2.0))
+                _send_frame(s, _MAGIC_REQ,
+                            self._registration_payload(resume=True))
+                frame = _recv_frame(s)
+                if frame is None or frame[0] != _MAGIC_WELCOME:
+                    s.close()
+                    continue
+                info = json.loads(frame[1].decode())
+                if not info.get("resume"):
+                    # The coordinator cannot resume this session (out
+                    # of its replay window, or the rank was already
+                    # promoted to lost) — fail over, don't retry.
+                    s.close()
+                    logger.warning("control-channel resume refused by "
+                                   "the coordinator")
+                    _RECONNECTS.inc(1, outcome="failed")
+                    return False
+                acked = int(info.get("recv_count", 0))
+                with self._send_lock:
+                    if not (0 <= acked <= self._up_count and
+                            self._up_count - acked <= len(self._up_log)):
+                        s.close()
+                        _RECONNECTS.inc(1, outcome="failed")
+                        return False
+                    for ordinal, magic, payload in self._up_log:
+                        if ordinal > acked:
+                            _send_frame(s, magic, payload)
+                    self._arm_sock(s)
+                    self._sock = s
+                self._last_recv_t = time.monotonic()
+                logger.info(
+                    "control channel resumed after %d attempt(s) "
+                    "(replayed %d uplink frames)", attempt,
+                    self._up_count - acked)
+                _RECONNECTS.inc(1, outcome="resumed")
+                return True
+            except (OSError, ValueError):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                continue
+        if not self._closing:
+            logger.warning("control channel could not be re-established "
+                           "within the %.1fs grace window", self._grace_s)
+            _RECONNECTS.inc(1, outcome="failed")
+        return False
+
+    # ------------------------------------------------------------------
+    # worker-side liveness (HB heartbeats)
+    # ------------------------------------------------------------------
+    def _hb_loop(self):
+        """Heartbeat timer: an HB frame rides the uplink whenever no
+        real traffic has flowed for a liveness interval (piggyback
+        suppression — steady-state training sends zero HBs).  Also the
+        evaluation point for the net.* / worker.wedge failpoints,
+        which model exactly the silent failures liveness exists to
+        catch."""
+        period = max(self._liveness_interval_s / 2.0, 0.05)
+        while not self._hb_stop.wait(period):
+            if self._closing:
+                return
+            if _fp.ENABLED:
+                # worker.wedge: partition(Ns) wedges this rank like a
+                # SIGSTOP — heartbeats stop, downlink processing stops
+                # (the recv loop checks the same window), the socket
+                # stays open.  Only coordinator liveness can see it.
+                if _fp.maybe_fail("worker.wedge",
+                                  rank=self.rank) == "drop":
+                    continue
+                # net.half_open: the peer vanishes without FIN — stop
+                # all sends permanently, keep the socket.
+                if _fp.maybe_fail("net.half_open",
+                                  rank=self.rank) == "drop":
+                    self._half_open = True
+                # net.conn_drop: a transient TCP drop — sever the live
+                # socket; the reconnect path must heal it.
+                if _fp.maybe_fail("net.conn_drop",
+                                  rank=self.rank) == "drop":
+                    self.debug_sever()
+                    continue
+            if self._wedged or self._half_open:
+                continue
+            if time.monotonic() - self._last_uplink_t < \
+                    self._liveness_interval_s:
+                continue  # real traffic is flowing; HB suppressed
+            if _fp.ENABLED and _fp.maybe_fail(
+                    "net.heartbeat_drop", rank=self.rank) == "drop":
+                continue
+            try:
+                with self._send_lock:
+                    self._send_frame_counted_locked(
+                        _MAGIC_HB, b"", "hb_frames", "HB")
+                _HEARTBEATS.inc(1, role="worker")
+            except OSError:
+                pass  # the recv loop owns link-death handling
+
+    # Harness hooks (tools/chaos_soak.py, tests/test_liveness.py):
+    # deterministic in-process analogs of SIGSTOP and a TCP RST.
+    def debug_wedge(self, on: bool = True):
+        """Freeze this rank's control plane without closing anything:
+        no heartbeats, no downlink processing — what SIGSTOP looks
+        like from the coordinator's side."""
+        self._wedged = on
+
+    def debug_half_open(self, on: bool = True):
+        """Peer-drops-without-FIN analog: sends stop, reads stop, the
+        socket object stays open so the coordinator gets no EOF."""
+        self._half_open = on
+
+    def debug_sever(self):
+        """Abruptly close the live control socket (transient network
+        drop); with reconnect enabled the channel must self-heal.
+        shutdown() first: close() alone does not release the kernel's
+        file reference while a thread is blocked inside recv, so no
+        FIN would reach the peer until that thread woke."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
     def set_broken_callback(self, fn):
         """Called once (from the recv thread) when the control-plane
@@ -1156,20 +1947,75 @@ class NetworkController(Controller):
             except Exception:
                 logger.warning("broken-callback failed", exc_info=True)
 
+    def _on_recv_idle(self):
+        if self._closing:
+            raise _LinkSilent("closing")
+        if self._wedged or self._half_open:
+            return  # a wedged rank detects nothing (SIGSTOP analog)
+        if time.monotonic() - self._last_recv_t > \
+                self._liveness_timeout_s:
+            raise _LinkSilent(
+                "coordinator silent for > %.1fs"
+                % self._liveness_timeout_s)
+
+    def _note_recv_data(self):
+        self._last_recv_t = time.monotonic()
+
     def _recv_loop(self):
+        bounded = self._liveness_interval_s > 0
         while True:
+            silent = False
             try:
-                frame = _recv_frame(self._sock)
+                if bounded:
+                    frame = _recv_frame_bounded(self._sock,
+                                                self._on_recv_idle,
+                                                self._note_recv_data)
+                else:
+                    frame = _recv_frame(self._sock)
             except OSError:
                 frame = None
-            if frame is None:
+            except _LinkSilent as e:
+                frame = None
                 if not self._closing:
-                    from .exceptions import HorovodInternalError
-                    self._set_broken(HorovodInternalError(
-                        "connection to the coordinator was lost "
-                        "(membership changed or rank 0 exited)"))
+                    silent = True
+                    logger.warning("liveness: %s", e)
+                    _LIVENESS_TIMEOUTS.inc(1, role="worker")
+            if frame is None:
+                if self._closing:
+                    return
+                # Transient-fault tolerance: try to resume the session
+                # inside the grace window before declaring the world
+                # broken.  A silent coordinator may just be a half-open
+                # socket on our side — a successful resume proves it.
+                if self._grace_s > 0 and self._reconnect():
+                    continue
+                if self._closing:
+                    return  # teardown raced the reconnect window
+                from .exceptions import HorovodInternalError
+                self._set_broken(HorovodInternalError(
+                    "coordinator liveness timeout (no control-plane "
+                    "traffic for %.1fs)" % self._liveness_timeout_s
+                    if silent else
+                    "connection to the coordinator was lost "
+                    "(membership changed or rank 0 exited)"))
                 return
             magic, payload = frame
+            while (self._wedged or self._half_open) and \
+                    not self._closing:
+                time.sleep(0.02)  # SIGSTOP analog: hold the frame
+            if _fp.ENABLED:
+                # worker.wedge=partition(Ns): downlink processing
+                # pauses for the window, like the harness flag above.
+                while not self._closing and _fp.maybe_fail(
+                        "worker.wedge", rank=self.rank) == "drop":
+                    time.sleep(0.02)
+            self._last_recv_t = time.monotonic()
+            if magic == _MAGIC_WELCOME:
+                continue  # handshake-only frame; not part of the stream
+            self._recv_count += 1
+            if magic == _MAGIC_HB:
+                _FRAMES_RECV.inc(1, kind="HB")
+                continue  # pure liveness signal
             # Failpoint site: downlink frame arrival on a worker.
             # drop() loses one response/cache frame for THIS rank only
             # — it falls out of lockstep with its peers, the shape of
@@ -1259,11 +2105,32 @@ class NetworkController(Controller):
                 _fp.maybe_fail("worker.frame_send",
                                rank=self.rank) == "drop":
             return
-        _send_frame(self._sock, magic, payload)
+        if self._selfheal is not None:
+            self._uplink_send_selfheal(magic, payload)
+        else:
+            _send_frame(self._sock, magic, payload)
         self.stats[stat_key] = self.stats.get(stat_key, 0) + 1
         self.stats["bytes_sent"] += len(payload) + 6
         _FRAMES_SENT.inc(1, kind=kind)
         _BYTES_SENT.inc(len(payload) + 6)
+
+    def _uplink_send_selfheal(self, magic: bytes, payload: bytes):
+        """Uplink send with the self-healing channel on: stamp the
+        heartbeat-suppression clock, log the frame for resume replay,
+        and — with reconnects enabled — absorb a dead-socket send (the
+        frame is in the up-log; the handshake replays it, so a
+        transient drop is invisible to the submitting thread)."""
+        self._last_uplink_t = time.monotonic()
+        if self._grace_s > 0:
+            self._up_count += 1
+            self._up_log.append((self._up_count, magic, payload))
+            try:
+                _send_frame(self._sock, magic, payload)
+            except OSError:
+                logger.debug("uplink send hit a dead socket; frame "
+                             "queued for resume replay")
+        else:
+            _send_frame(self._sock, magic, payload)
 
     def _spawn_metrics_reply(self):
         """MR replies ride their own short-lived thread: the recv
@@ -1473,6 +2340,7 @@ class NetworkController(Controller):
 
     def shutdown(self):
         self._closing = True
+        self._hb_stop.set()
         try:
             with self._send_lock:
                 _send_frame(self._sock, _MAGIC_REQ,
@@ -1499,7 +2367,7 @@ class NetworkController(Controller):
         shut down, operations.cc:539-585).  Elastic resets use a short
         cap: peers fail over via the broken-membership path anyway."""
         timeout = 5.0 if self.state.knobs.elastic else \
-            float(os.environ.get("HOROVOD_START_TIMEOUT", 120))
+            env_mod.start_timeout()
         deadline = time.monotonic() + timeout
         prev_seen = -1
         stagnant_since = time.monotonic()

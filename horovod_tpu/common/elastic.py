@@ -18,11 +18,23 @@ commit cadence and removes a listening socket from every worker.
 import io
 import logging
 import queue
+import time
 from typing import Callable, Dict, List, Optional
 
+from . import metrics
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 logger = logging.getLogger("horovod_tpu.elastic")
+
+# Recovery-pipeline phase timings (docs/failure_recovery.md): the
+# retry loop below observes restore/reset, the chaos MTTR drill and
+# the elastic driver path observe detect/resume — one histogram tells
+# the whole detect → restore → resume story.
+RECOVERY_SECONDS = metrics.histogram(
+    "hvd_recovery_seconds",
+    "Failure-recovery pipeline wall time, by phase (detect = fault to "
+    "survivor unwind; restore = state restore; reset = runtime "
+    "re-init; resume = restore to first post-restore step)")
 
 
 class HostUpdateSource:
@@ -226,13 +238,19 @@ def run_fn(func: Callable, reset: Callable):
                 except HorovodInternalError:
                     logger.info("elastic: internal error; restoring last "
                                 "committed state")
+                    t0 = time.perf_counter()
                     state.restore()
+                    RECOVERY_SECONDS.observe(time.perf_counter() - t0,
+                                             phase="restore")
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:
                     logger.info("elastic: hosts updated; re-initializing")
                     skip_sync = e.skip_sync
+                t0 = time.perf_counter()
                 reset()
                 state.on_reset()
+                RECOVERY_SECONDS.observe(time.perf_counter() - t0,
+                                         phase="reset")
         finally:
             notification_manager.remove_listener(state)
 

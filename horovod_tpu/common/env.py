@@ -49,6 +49,49 @@ HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 
+# --- robustness / self-healing control plane -----------------------------
+# Bounds how long workers wait for each other at init: the controller
+# connect loop, the rendezvous addr lookup, the elastic re-rendezvous
+# and the coordinator drain all derive their deadline from this one
+# knob (launcher --start-timeout; reference launch.py start_timeout
+# contract).  Historically each site re-read the variable with its own
+# default; `start_timeout()` is now the single parse point.
+HOROVOD_START_TIMEOUT = "HOROVOD_START_TIMEOUT"
+START_TIMEOUT_DEFAULT = 120.0
+# Control-plane liveness: when the interval is > 0, every worker sends
+# lightweight HB heartbeat frames on its coordinator link (suppressed
+# while real traffic flows) and the coordinator runs a liveness sweep,
+# so a wedged-but-connected rank (SIGSTOP, GIL deadlock, half-open
+# socket) is detected within ~2x the interval even with no collective
+# pending.  0 (default) = disabled; liveness pins the Python
+# coordinator (the native one has no HB handling — same gating as
+# autotune/metrics aggregation/failpoints).
+HOROVOD_LIVENESS_INTERVAL = "HOROVOD_LIVENESS_INTERVAL"
+# Silence threshold before a peer is presumed dead.  Default (unset or
+# 0): 2x the liveness interval.
+HOROVOD_LIVENESS_TIMEOUT = "HOROVOD_LIVENESS_TIMEOUT"
+# Reconnecting control channel: a worker whose coordinator socket dies
+# retries with jittered exponential backoff inside this grace window,
+# while the coordinator holds the rank in limbo and replays missed
+# frames on resume — a transient TCP drop no longer breaks the world.
+# Default (unset or 0 with liveness enabled): the liveness timeout;
+# explicit 0 with liveness disabled = reconnects off (legacy fail-fast
+# behavior).
+HOROVOD_RECONNECT_GRACE = "HOROVOD_RECONNECT_GRACE"
+# Bound on the registration-phase first frame: a client that connects
+# and never identifies its rank is cut after this many seconds
+# (previously a hardcoded 30 s).
+HOROVOD_REGISTRATION_TIMEOUT = "HOROVOD_REGISTRATION_TIMEOUT"
+
+
+def start_timeout(default: float = None) -> float:
+    """The HOROVOD_START_TIMEOUT deadline (seconds), parsed freshly on
+    every call so tests and elastic re-inits that mutate the env see
+    the current value."""
+    return env_float(HOROVOD_START_TIMEOUT,
+                     START_TIMEOUT_DEFAULT if default is None else default)
+
+
 # --- observability --------------------------------------------------------
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 # Opt-in Prometheus-text /metrics endpoint: set to a port (0 = pick an
@@ -184,9 +227,28 @@ class Knobs:
     stall_shutdown_time_s: float = 0.0
     elastic: bool = False
     tpu_operations: str = "XLA"
+    # Self-healing control plane (docs/failure_recovery.md).
+    # liveness_timeout_s / reconnect_grace_s may be given as 0 =
+    # "derive the default"; __post_init__ resolves them ONCE for every
+    # construction path (env, tests, chaos harness), so consumers read
+    # final values.
+    start_timeout_s: float = START_TIMEOUT_DEFAULT
+    liveness_interval_s: float = 0.0   # 0 = liveness disabled
+    liveness_timeout_s: float = 0.0    # 0 -> 2x interval
+    reconnect_grace_s: float = 0.0     # 0 -> liveness timeout
+    registration_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if not self.liveness_timeout_s:
+            self.liveness_timeout_s = 2.0 * self.liveness_interval_s
+        if not self.reconnect_grace_s:
+            self.reconnect_grace_s = self.liveness_timeout_s
 
     @classmethod
     def from_env(cls) -> "Knobs":
+        liveness_interval = env_float(HOROVOD_LIVENESS_INTERVAL, 0.0)
+        liveness_timeout = env_float(HOROVOD_LIVENESS_TIMEOUT, 0.0)
+        reconnect_grace = env_float(HOROVOD_RECONNECT_GRACE, 0.0)
         return cls(
             fusion_threshold_bytes=env_int(
                 HOROVOD_FUSION_THRESHOLD, 64 * 1024 * 1024),
@@ -218,4 +280,10 @@ class Knobs:
                 HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
             elastic=env_bool(HOROVOD_ELASTIC),
             tpu_operations=os.environ.get(HOROVOD_TPU_OPERATIONS, "XLA"),
+            start_timeout_s=start_timeout(),
+            liveness_interval_s=liveness_interval,
+            liveness_timeout_s=liveness_timeout,
+            reconnect_grace_s=reconnect_grace,
+            registration_timeout_s=env_float(
+                HOROVOD_REGISTRATION_TIMEOUT, 30.0),
         )

@@ -3,7 +3,9 @@
 An etcd/TiKV-style failpoint registry: production code declares named
 injection *sites* at the places that can fail for real (ring transport
 dispatch, coordinator frame I/O, the runtime cycle, rendezvous KV
-requests, elastic worker lifecycle); an operator or test configures
+requests, elastic worker lifecycle, the liveness/reconnect plane:
+``net.heartbeat_drop``/``net.conn_drop``/``net.half_open``/
+``worker.wedge``); an operator or test configures
 *rules* against those sites through ``HOROVOD_FAILPOINTS``::
 
     HOROVOD_FAILPOINTS='ring.send=delay(50ms,p=0.1);coord.frame_recv=drop(1);

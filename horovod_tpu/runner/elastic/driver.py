@@ -45,6 +45,14 @@ DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 # KV scopes/keys the driver publishes (worker side reads these).
 ELASTIC_SCOPE = "elastic"
 KEY_GENERATION = "generation"     # bumped on every discovery change
+# Written by the rank-0 worker's coordinator (controller_net
+# _make_rank_lost_publisher) when liveness/reconnect machinery
+# promotes a rank to lost: the driver polls it so a WEDGED worker —
+# whose process never exits, so the spawn monitor never fires — still
+# gets its host evicted and the world replanned.  Keyed per rank
+# ("lost-<rank>") so correlated failures inside one poll interval
+# don't overwrite each other.
+KEY_LOST_RANK = "lost-%d"
 # Driver-process metrics snapshot, readable through the (job-secret
 # guarded) rendezvous HTTP server at GET /metrics/driver — the driver
 # has no worker /metrics endpoint, so the KV store is its read path.
@@ -97,6 +105,7 @@ class ElasticDriver:
         self._shutdown = threading.Event()
         self._error_message: Optional[str] = None
         self._ckpt_latest: Optional[int] = None
+        self._lost_handled: set = set()   # (epoch, rank) dedup
         self._discovery_thread = threading.Thread(
             target=self._discover_hosts, name="hvd-elastic-discovery",
             daemon=True)
@@ -378,6 +387,49 @@ class ElasticDriver:
         except Exception:
             logger.debug("driver metrics publish failed", exc_info=True)
 
+    def _poll_lost_ranks(self):
+        """Act on lost-rank notices the rank-0 coordinator published:
+        record the failure against the rank's slot so the registry
+        barrier fires and the host is blacklisted — the eviction path
+        for a wedged worker whose process never exits."""
+        if self._rendezvous is None or self._rendezvous.kvstore is None:
+            return
+        with self._lock:
+            slots = [s for ss in self._host_assignments.values()
+                     for s in ss]
+        for slot in slots:
+            try:
+                raw = self._rendezvous.kvstore.get(
+                    ELASTIC_SCOPE, KEY_LOST_RANK % slot.rank)
+            except Exception:
+                # Per-slot, logged, and non-aborting: a KV hiccup must
+                # not silently disable wedged-host eviction (the
+                # checkpoint-coordinator silent-swallow lesson).
+                logger.warning("elastic: lost-rank poll failed for "
+                               "rank %d; will retry next tick",
+                               slot.rank, exc_info=True)
+                continue
+            if raw is None:
+                continue
+            try:
+                notice = json.loads(raw.decode())
+                rank = int(notice["rank"])
+                epoch = int(notice.get("epoch", 0))
+            except (ValueError, KeyError):
+                continue
+            with self._lock:
+                if epoch and epoch != self._epoch:
+                    continue  # stale notice from a replaced epoch
+                if (epoch, rank) in self._lost_handled:
+                    continue
+                self._lost_handled.add((epoch, rank))
+            logger.warning(
+                "elastic: coordinator promoted rank %d (%s:%d) to "
+                "lost (%s); evicting", rank, slot.hostname,
+                slot.local_rank, notice.get("reason", "?"))
+            self._registry.record_failure(slot.hostname,
+                                          slot.local_rank)
+
     def _discover_hosts(self):
         while not self._shutdown.is_set():
             try:
@@ -385,6 +437,7 @@ class ElasticDriver:
             except Exception:
                 logger.exception("host discovery failed; retrying")
                 changed = False
+            self._poll_lost_ranks()
             self._publish_metrics()
             if changed:
                 with self._lock:

@@ -64,8 +64,7 @@ def elastic_rendezvous(timeout: Optional[float] = None) -> Dict:
     client = _client()
     hostname = os.environ.get(env_mod.HOROVOD_HOSTNAME, "localhost")
     local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))
-    timeout = timeout or float(os.environ.get("HOROVOD_START_TIMEOUT",
-                                              600))
+    timeout = timeout or env_mod.start_timeout()
     deadline = time.monotonic() + timeout
     key = f"{hostname}:{local_rank}?last_epoch={_last_epoch}"
     while time.monotonic() < deadline:

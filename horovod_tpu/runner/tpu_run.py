@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..common import env as env_mod
 from . import safe_shell_exec
 from .hosts import SlotInfo, get_host_assignments, parse_hosts, \
     slot_env_vars
@@ -201,8 +202,10 @@ def launch_static(command: List[str],
         common_env["HOROVOD_RANK0_ADDR"] = rank0_addr
     if start_timeout:
         # Bounds how long workers wait for each other at init
-        # (consumed by the controller's connect loop).
-        common_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
+        # (consumed through env.start_timeout(): the controller
+        # connect loop, rendezvous lookups, elastic re-rendezvous,
+        # the coordinator drain and the formation deadline).
+        common_env[env_mod.HOROVOD_START_TIMEOUT] = str(start_timeout)
     if extra_worker_env:
         common_env.update(extra_worker_env)
 

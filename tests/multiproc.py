@@ -90,6 +90,24 @@ def run_workers(body: str, nproc: int = 2, timeout: float = 180.0,
         # (tests/test_steady_state_replay.py passes the env
         # explicitly), the chaos kill drill, and the bench lanes.
         supplied.setdefault("HOROVOD_STEADY_STATE_REPLAY", "0")
+        # Liveness ON by default with tight (test-scale) values: a
+        # wedged or killed worker surfaces within seconds instead of
+        # hanging a suite to its subprocess timeout.  HB frames ride
+        # their own stats key/metric label, so the legacy CH/RQ
+        # frame-count assertions are unaffected.  Skipped when the
+        # test pins the native coordinator: the self-healing channel
+        # is Python-coordinator-only (HB frames would kill native
+        # links), and strict-native + liveness is a config error by
+        # design.  Known tradeoff: this also removes AUTO-native
+        # selection from the non-pinned suites — native-coordinator
+        # protocol coverage now lives entirely in the suites that set
+        # HOROVOD_TPU_NATIVE=1 (test_native_coordinator and the [1]
+        # variants of ring/response-cache/replay tests).
+        if supplied.get("HOROVOD_TPU_NATIVE", "").strip().lower() \
+                not in ("1", "true", "on", "yes"):
+            supplied.setdefault("HOROVOD_LIVENESS_INTERVAL", "3")
+            supplied.setdefault("HOROVOD_LIVENESS_TIMEOUT", "15")
+            supplied.setdefault("HOROVOD_RECONNECT_GRACE", "10")
         env.update(supplied)
         # Workers default to 1 CPU device: scrub the conftest's
         # 8-device XLA_FLAGS unless the test supplied its own.

@@ -74,6 +74,9 @@ def test_chaos_soak_full():
     assert any(r["outcome"] == "recovered" for r in report["schedules"])
     assert report["recovery_latency"]["count"] >= 1
     assert report["recovery_latency"]["max_s"] < 60.0
+    assert report["recovery_latency"]["p50_s"] is not None
+    assert report["recovery_latency"]["p50_s"] <= \
+        report["recovery_latency"]["max_s"]
     # Artifact carries the observability payload.
     assert "hvd_negotiation_rounds_total" in \
         report["metrics"]["counters"]

@@ -324,6 +324,70 @@ def test_kv_coordinator_over_real_rendezvous():
         server.stop()
 
 
+def test_kv_gather_dead_rendezvous_aborts_early(monkeypatch):
+    """A dead rendezvous must surface as an early abandoned gather
+    (capped retries with backoff, warning, counter) — NOT stall the
+    two-phase commit silently to its full deadline (the pre-fix
+    `raw = None  # transient; retry next poll` hole)."""
+    import time
+
+    from horovod_tpu.checkpoint import coordinator as coord_mod
+    from horovod_tpu.common import metrics as hm
+
+    monkeypatch.setattr(coord_mod, "_KV_ERROR_CAP", 5)
+
+    class DeadClient:
+        calls = 0
+
+        def get(self, scope, key):
+            DeadClient.calls += 1
+            raise OSError("connection refused")
+
+        def put(self, scope, key, value):
+            raise OSError("connection refused")
+
+    errors = hm.REGISTRY.counter("hvd_ckpt_kv_errors_total")
+    before = errors.value(op="gather")
+    coord = KVCommitCoordinator(DeadClient(), poll_interval_s=0.01)
+    t0 = time.monotonic()
+    # Deadline of 60s, but the error cap must abort WAY earlier.
+    assert coord.gather(3, 2, timeout=60.0) is None
+    elapsed = time.monotonic() - t0
+    assert elapsed < 15.0, elapsed
+    assert errors.value(op="gather") > before
+    # The non-gather ops count too (and stay non-fatal).
+    coord.mark_committed(3)
+    assert coord.committed_step() is None
+    assert errors.value(op="mark_committed") >= 1
+    assert errors.value(op="committed_step") >= 1
+
+
+def test_kv_gather_survives_transient_blip():
+    """A few failed polls followed by recovery must still gather (the
+    cap is for DEAD rendezvous, not a blip)."""
+
+    class BlippyClient:
+        def __init__(self):
+            self.fails = 4
+            self.store = {}
+
+        def get(self, scope, key):
+            if self.fails > 0:
+                self.fails -= 1
+                raise OSError("blip")
+            return self.store.get((scope, key))
+
+        def put(self, scope, key, value):
+            self.store[(scope, key)] = value
+
+    client = BlippyClient()
+    coord = KVCommitCoordinator(client, poll_interval_s=0.01)
+    client.put("ckpt", "prepare-5-0", b'{"rank": 0}')
+    client.put("ckpt", "prepare-5-1", b'{"rank": 1}')
+    marks = coord.gather(5, 2, timeout=20.0)
+    assert marks is not None and [m["rank"] for m in marks] == [0, 1]
+
+
 def test_kv_prepare_drop_failpoint_times_out():
     from horovod_tpu.runner.http_server import (RendezvousClient,
                                                 RendezvousServer)

@@ -230,3 +230,43 @@ def test_driver_publishes_metrics_to_rendezvous():
         driver.stop()
     finally:
         rdv.stop()
+
+
+def test_driver_evicts_host_on_published_lost_rank():
+    """A WEDGED worker never exits, so the spawn monitor can't see it
+    fail; the rank-0 coordinator's liveness promotion publishes an
+    elastic/lost notice instead, and the driver must record the slot
+    failed (→ host blacklisted at barrier evaluation) from the KV
+    alone (docs/failure_recovery.md)."""
+    import json
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    workers = FakeWorkers()
+    rdv = RendezvousServer(secret="")
+    rdv.start()
+    try:
+        driver = ElasticDriver(rendezvous=rdv,
+                               discovery=FixedHosts({"a": 2}),
+                               min_np=2, timeout=5)
+        driver.start(2, workers.create)
+        epoch = driver.epoch
+        # Stale-epoch notices are ignored.
+        rdv.kvstore.put("elastic", "lost-1", json.dumps(
+            {"rank": 1, "reason": "liveness timeout",
+             "epoch": epoch + 7}).encode())
+        driver._poll_lost_ranks()
+        assert not driver.registry.get_recorded("FAILURE")
+        # Current-epoch notice: the slot is recorded failed.
+        rdv.kvstore.put("elastic", "lost-1", json.dumps(
+            {"rank": 1, "reason": "liveness timeout",
+             "epoch": epoch}).encode())
+        driver._poll_lost_ranks()
+        assert "a:1" in driver.registry.get_recorded("FAILURE")
+        # Dedup: re-polling the same notice records nothing new.
+        driver._poll_lost_ranks()
+        assert len(driver.registry.get_recorded("FAILURE")) == 1
+        workers.release_all(0)
+        driver.stop()
+    finally:
+        rdv.stop()

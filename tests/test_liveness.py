@@ -1,0 +1,404 @@
+"""Self-healing control plane: bounded-time liveness, the
+reconnecting control channel, and the measured detect→restore→resume
+pipeline (docs/failure_recovery.md).
+
+The failure modes under test are exactly the ones the pre-liveness
+control plane could NOT see: a client that connects and never speaks,
+a SIGSTOP-wedged rank holding every socket open, a half-open socket
+(peer drops without FIN), and a transient TCP drop that should never
+have broken the world in the first place.  Tier-1 keeps the short
+deterministic drills (seconds, like test_chaos_smoke); the full
+fault x phase MTTR matrix rides the `slow` marker.
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from chaos_soak import (ChaosWorld, run_mttr_drill,  # noqa: E402
+                        run_mttr_matrix)
+
+from horovod_tpu.common import env as env_mod  # noqa: E402
+from horovod_tpu.common import failpoints as fp  # noqa: E402
+from horovod_tpu.common import metrics as hm  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# knob parsing (the one-default centralization satellite)
+# ---------------------------------------------------------------------------
+
+def test_start_timeout_single_parse_point(monkeypatch):
+    monkeypatch.delenv("HOROVOD_START_TIMEOUT", raising=False)
+    assert env_mod.start_timeout() == env_mod.START_TIMEOUT_DEFAULT
+    monkeypatch.setenv("HOROVOD_START_TIMEOUT", "33")
+    assert env_mod.start_timeout() == 33.0
+    # Parsed freshly per call: elastic re-inits mutate the env.
+    monkeypatch.setenv("HOROVOD_START_TIMEOUT", "44")
+    assert env_mod.start_timeout() == 44.0
+    assert env_mod.start_timeout(default=7.0) == 44.0
+    monkeypatch.delenv("HOROVOD_START_TIMEOUT")
+    assert env_mod.start_timeout(default=7.0) == 7.0
+
+
+def test_no_stray_start_timeout_parsers():
+    """The satellite that motivated env.start_timeout(): no production
+    module re-reads the variable with its own default anymore."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        ["grep", "-rln", "environ.get(\"HOROVOD_START_TIMEOUT",
+         os.path.join(root, "horovod_tpu")],
+        capture_output=True, text=True).stdout
+    offenders = [l for l in out.splitlines()
+                 if "__pycache__" not in l and
+                 not l.endswith("common/env.py")]
+    assert not offenders, offenders
+
+
+def test_liveness_knob_defaults(monkeypatch):
+    from horovod_tpu.common.env import Knobs
+    for k in ("HOROVOD_LIVENESS_INTERVAL", "HOROVOD_LIVENESS_TIMEOUT",
+              "HOROVOD_RECONNECT_GRACE",
+              "HOROVOD_REGISTRATION_TIMEOUT"):
+        monkeypatch.delenv(k, raising=False)
+    knobs = Knobs.from_env()
+    assert knobs.liveness_interval_s == 0.0      # off by default
+    assert knobs.reconnect_grace_s == 0.0
+    assert knobs.registration_timeout_s == 30.0
+    monkeypatch.setenv("HOROVOD_LIVENESS_INTERVAL", "2.5")
+    knobs = Knobs.from_env()
+    assert knobs.liveness_interval_s == 2.5
+    assert knobs.liveness_timeout_s == 5.0       # 2x interval
+    assert knobs.reconnect_grace_s == 5.0        # inherits the timeout
+    monkeypatch.setenv("HOROVOD_LIVENESS_TIMEOUT", "9")
+    monkeypatch.setenv("HOROVOD_RECONNECT_GRACE", "4")
+    monkeypatch.setenv("HOROVOD_REGISTRATION_TIMEOUT", "1.5")
+    knobs = Knobs.from_env()
+    assert knobs.liveness_timeout_s == 9.0
+    assert knobs.reconnect_grace_s == 4.0
+    assert knobs.registration_timeout_s == 1.5
+
+
+# ---------------------------------------------------------------------------
+# registration-phase silence (connected-but-never-speaks client)
+# ---------------------------------------------------------------------------
+
+def test_silent_registration_client_cut_by_knob():
+    """A client that connects and never identifies its rank must be
+    cut after HOROVOD_REGISTRATION_TIMEOUT (previously hardcoded 30 s)
+    and must not block later, well-behaved registrations."""
+    from horovod_tpu.common.controller_net import (CoordinatorServer,
+                                                   _send_frame)
+    server = CoordinatorServer(size=2, port=0,
+                               registration_timeout_s=0.4)
+    try:
+        t0 = time.monotonic()
+        silent = socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=5.0)
+        silent.settimeout(3.0)
+        # The server must hang up on us (EOF) within ~the knob, not 30s.
+        assert silent.recv(1) == b""
+        cut_after = time.monotonic() - t0
+        assert cut_after < 5.0, cut_after
+        silent.close()
+        # The accept loop is free again: a real registration lands.
+        good = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=5.0)
+        _send_frame(good, b"RQ", struct.pack("<i", 0))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and 0 not in server._conns:
+            time.sleep(0.02)
+        assert 0 in server._conns
+        good.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# connected-but-silent failures mid-training (the liveness bound)
+# ---------------------------------------------------------------------------
+
+def _warm_world(ranks=4, interval=0.3):
+    world = ChaosWorld(ranks, stall_shutdown_s=6.0,
+                       liveness_interval_s=interval,
+                       reconnect_grace_s=2 * interval)
+    fatal = world.watch_fatal()
+    import threading
+    for i in range(2):
+        ts = []
+        for r in range(ranks):
+            def go(r=r, i=i):
+                world.collective(r, "allreduce", "lv.warm", np.full(
+                    (17,), r + 1.0, np.float32), i, 15.0)
+            t = threading.Thread(target=go, daemon=True)
+            t.start()
+            ts.append(t)
+        for t in ts:
+            t.join(timeout=20)
+    return world, fatal
+
+
+def _assert_detected(fatal, world, victim, t_fault, bound_s):
+    survivors = [r for r in range(world.size) if r != victim]
+    deadline = t_fault + bound_s
+    while time.monotonic() < deadline and \
+            not all(r in fatal for r in survivors):
+        time.sleep(0.02)
+    missing = [r for r in survivors if r not in fatal]
+    assert not missing, \
+        "survivors %s never learned within %.1fs" % (missing, bound_s)
+    return max(fatal[r] for r in survivors) - t_fault
+
+
+def test_wedged_rank_detected_while_idle():
+    """SIGSTOP analog with NO collective pending: only the HB cadence
+    can expose it, and every survivor must unwind via the fast AB
+    notice — the stall clock (6 s here) must play no part."""
+    timeouts = hm.REGISTRY.counter("hvd_liveness_timeouts_total")
+    before = timeouts.value(role="coordinator")
+    world, fatal = _warm_world(interval=0.3)
+    try:
+        t0 = time.monotonic()
+        world.wedge_rank(2)
+        detect = _assert_detected(fatal, world, 2, t0, bound_s=8.0)
+        # 2x interval (timeout) + sweep + delivery, with CI-noise slack
+        # (the clock this replaces was 60 s).
+        assert detect < 4.0, detect
+        assert timeouts.value(role="coordinator") >= before + 1
+    finally:
+        world.close()
+
+
+def test_half_open_socket_detected():
+    """Peer drops without FIN: the socket object stays open, nothing
+    flows.  Indistinguishable from a wedge on the wire — and detected
+    by the same bound."""
+    world, fatal = _warm_world(interval=0.3)
+    try:
+        t0 = time.monotonic()
+        world.runtimes[1].controller.debug_half_open(True)
+        detect = _assert_detected(fatal, world, 1, t0, bound_s=8.0)
+        assert detect < 4.0, detect
+    finally:
+        world.close()
+
+
+def test_transient_drop_resumes_same_world():
+    """A single transient connection drop inside the grace window:
+    the SAME world resumes, results stay bit-identical, and not one
+    HorovodInternalError fires."""
+    rec = run_mttr_drill(fault="conn_drop", when="idle", ranks=4,
+                         seed=3)
+    assert rec["ok"], rec
+    assert rec["fatal_events"] == []
+    assert rec["reconnects_resumed"] >= 1
+    assert rec["params_bit_identical"]
+    assert not rec["errors"] and not rec["results_bad"]
+
+
+def test_conn_drop_failpoint_site_heals():
+    """The env-contract way to inject the same fault:
+    net.conn_drop=drop(...) fires on the victim's heartbeat tick,
+    severs the live socket, and the channel must self-heal without
+    anyone noticing."""
+    import threading
+    resumed_c = hm.REGISTRY.counter("hvd_reconnects_total")
+    before = resumed_c.value(outcome="resumed")
+    fp.configure("net.conn_drop=drop(1,rank=1)", seed=5)
+    try:
+        world = ChaosWorld(4, stall_shutdown_s=6.0,
+                           liveness_interval_s=0.3,
+                           reconnect_grace_s=1.0)
+        fatal = world.watch_fatal()
+        try:
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline and \
+                    resumed_c.value(outcome="resumed") < before + 1:
+                time.sleep(0.05)
+            assert resumed_c.value(outcome="resumed") >= before + 1
+            # And the healed channel still carries real traffic.
+            outs = {}
+            ts = []
+            for r in range(4):
+                def go(r=r):
+                    outs[r] = world.collective(
+                        r, "allreduce", "lv.heal",
+                        np.full((9,), r + 1.0, np.float32), 0, 15.0)
+                t = threading.Thread(target=go, daemon=True)
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=20)
+            expected = np.full((9,), sum(r + 1.0 for r in range(4)),
+                               np.float32)
+            for r in range(4):
+                np.testing.assert_allclose(outs[r], expected)
+            assert not fatal, fatal
+            trig = fp.snapshot()["net.conn_drop"][0]
+            assert trig["triggers"] == 1
+        finally:
+            world.close()
+    finally:
+        fp.reset()
+
+
+def test_grace_only_config_still_promotes_dead_ranks():
+    """Reconnect grace WITHOUT liveness (interval 0): the sweep must
+    still run — a permanently dead rank parks in limbo and only the
+    grace-expiry sweep can promote it.  (Review-found regression: the
+    sweep used to start only when liveness was armed, so this config
+    hung forever.)"""
+    import threading
+    world = ChaosWorld(3, stall_shutdown_s=8.0,
+                       liveness_interval_s=0.0,
+                       reconnect_grace_s=0.8)
+    fatal = world.watch_fatal()
+    try:
+        for i in range(2):
+            ts = []
+            for r in range(3):
+                def go(r=r, i=i):
+                    world.collective(r, "allreduce", "lv.go",
+                                     np.full((5,), r + 1.0,
+                                             np.float32), i, 15.0)
+                t = threading.Thread(target=go, daemon=True)
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=20)
+        t0 = time.monotonic()
+        world.kill_rank(2)
+        detect = _assert_detected(fatal, world, 2, t0, bound_s=8.0)
+        assert detect < 5.0, detect  # grace + EOF notice + sweep + slack
+    finally:
+        world.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 MTTR smoke (kill + wedge of 8 in-process ranks)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_mttr_smoke_kill_8_ranks():
+    """Kill one of 8 ranks while idle: detection within the
+    grace-window bound, bit-identical restore from the last committed
+    checkpoint, first post-restore step lands, replay re-engages."""
+    rec = run_mttr_drill(fault="kill", when="idle", ranks=8, seed=7)
+    assert rec["ok"], rec
+    # grace (2x interval) + EOF poll + sweep, with CI slack.
+    assert rec["detect_s"] < 4.0, rec["detect_s"]
+    assert rec["bit_identical"]
+    assert rec["mttr_s"] is not None and rec["mttr_s"] < 15.0
+    assert rec["replay_reengaged"]
+
+
+@pytest.mark.chaos
+def test_mttr_smoke_wedge_8_ranks():
+    """SIGSTOP-wedge one of 8 ranks while idle: the heartbeat bound
+    (2x interval + sweep) detects it with zero traffic in flight."""
+    rec = run_mttr_drill(fault="wedge", when="idle", ranks=8, seed=9)
+    assert rec["ok"], rec
+    assert rec["detect_s"] < 4.0, rec["detect_s"]
+    assert rec["bit_identical"]
+    assert rec["replay_reengaged"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_mttr_matrix_full():
+    """The full kill/wedge/transient-drop x idle/during-replay/
+    during-negotiation matrix, artifact shape included."""
+    report = run_mttr_matrix(ranks=8, seed=13)
+    assert report["ok"], [
+        {k: c.get(k) for k in ("fault", "when", "ok", "errors",
+                               "results_bad")}
+        for c in report["cells"] if not c.get("ok")]
+    assert len(report["cells"]) == 9
+    assert report["mttr_s"]["p50"] is not None
+    assert report["detect_s"]["p90"] is not None
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead-when-disabled (the PR 2 precedent)
+# ---------------------------------------------------------------------------
+
+def test_disabled_heartbeat_cost_is_one_attribute_check():
+    """With liveness and reconnects off, the hot submit path's only
+    self-healing cost is the `self._selfheal is not None` gate.
+    Mirrors test_disabled_path_overhead_stays_one_attribute_check."""
+    import timeit
+
+    class _Stub:
+        _selfheal = None
+
+    stub = _Stub()
+    n = 200_000
+    per_call = timeit.timeit(
+        "c._selfheal is not None and c.note()",
+        globals={"c": stub}, number=n) / n
+    assert per_call < 1e-6, \
+        "disabled self-heal guard costs %.0f ns/op (>1 us)" \
+        % (per_call * 1e9)
+
+
+def test_disabled_heartbeat_never_enters_selfheal_path():
+    """Behavioral booby-trap: with the knobs unset, a real collective
+    through a networked world must never call the self-heal uplink
+    helper (monkeypatching it to explode would otherwise detonate)."""
+    import threading
+
+    from horovod_tpu.common.controller_net import NetworkController
+
+    def boom(self, *a, **k):
+        raise AssertionError("self-heal path entered while disabled")
+
+    orig = NetworkController._uplink_send_selfheal
+    NetworkController._uplink_send_selfheal = boom
+    try:
+        world = ChaosWorld(2, stall_shutdown_s=6.0)  # liveness off
+        try:
+            ctrl = world.runtimes[1].controller
+            assert ctrl._selfheal is None
+            assert ctrl._hb_thread is None
+            outs = {}
+            ts = []
+            for r in range(2):
+                def go(r=r):
+                    outs[r] = world.collective(
+                        r, "allreduce", "lv.off",
+                        np.full((5,), 1.0, np.float32), 0, 15.0)
+                t = threading.Thread(target=go, daemon=True)
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=20)
+            np.testing.assert_allclose(outs[0], 2.0)
+        finally:
+            world.close()
+    finally:
+        NetworkController._uplink_send_selfheal = orig
+
+
+def test_strict_native_rejects_liveness(monkeypatch):
+    """HOROVOD_TPU_NATIVE=1 + liveness is a config error, not a silent
+    demotion (the native coordinator treats any non-CH/RQ frame — an
+    HB heartbeat included — as a departed rank)."""
+    from chaos_soak import _StateStub, _free_port, soak_knobs
+    from horovod_tpu.common.controller_net import NetworkController
+    monkeypatch.setenv("HOROVOD_TPU_NATIVE", "1")
+    monkeypatch.setenv("HOROVOD_CONTROLLER_ADDR",
+                       "127.0.0.1:%d" % _free_port())
+    monkeypatch.delenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", raising=False)
+    st = _StateStub(0, 2, soak_knobs(0.0, liveness_interval_s=5.0))
+    with pytest.raises(RuntimeError,
+                       match="HOROVOD_LIVENESS_INTERVAL"):
+        NetworkController(st)

@@ -45,6 +45,8 @@ import json
 import logging
 import os
 import random
+import shutil
+import socket
 import sys
 import threading
 import time
@@ -214,10 +216,14 @@ def _free_port() -> int:
     return port
 
 
-def soak_knobs(stall_shutdown_s: float) -> Knobs:
+def soak_knobs(stall_shutdown_s: float,
+               liveness_interval_s: float = 0.0,
+               liveness_timeout_s: float = 0.0,
+               reconnect_grace_s: float = 0.0) -> Knobs:
     """Robustness machinery tightened to soak time scales: a dropped
     frame must surface through stall shutdown in seconds, not the
-    production 60s."""
+    production 60s.  MTTR/liveness drills additionally arm HB
+    heartbeats + the reconnect grace window at sub-second cadence."""
     return Knobs(
         cache_capacity=1024,
         cycle_time_ms=1.0,
@@ -225,6 +231,9 @@ def soak_knobs(stall_shutdown_s: float) -> Knobs:
         stall_warning_time_s=max(stall_shutdown_s / 4.0, 0.25),
         stall_shutdown_time_s=stall_shutdown_s,
         hierarchical_allreduce=False,
+        liveness_interval_s=liveness_interval_s,
+        liveness_timeout_s=liveness_timeout_s,
+        reconnect_grace_s=reconnect_grace_s,
     )
 
 
@@ -233,7 +242,9 @@ class ChaosWorld:
     (rank 0 hosting the coordinator) and the simulated data plane."""
 
     def __init__(self, size: int, stall_shutdown_s: float = 4.0,
-                 exchange_timeout_s: float = 8.0):
+                 exchange_timeout_s: float = 8.0,
+                 liveness_interval_s: float = 0.0,
+                 reconnect_grace_s: float = 0.0):
         from horovod_tpu.common.runtime import BackgroundRuntime
 
         self.size = size
@@ -244,7 +255,9 @@ class ChaosWorld:
         self._set_env("HOROVOD_START_TIMEOUT", "30")
         self._set_env("HOROVOD_GLOO_RENDEZVOUS_ADDR", None)
         self._set_env("HOROVOD_GLOO_RENDEZVOUS_PORT", None)
-        knobs = soak_knobs(stall_shutdown_s)
+        knobs = soak_knobs(stall_shutdown_s,
+                           liveness_interval_s=liveness_interval_s,
+                           reconnect_grace_s=reconnect_grace_s)
         self.runtimes = []
         try:
             for rank in range(size):  # rank 0 first: it hosts the server
@@ -273,9 +286,43 @@ class ChaosWorld:
         ctrl = rt.controller
         ctrl._closing = True
         try:
+            # shutdown() actually sends the FIN even while the rank's
+            # recv thread is blocked inside the syscall (a bare close
+            # keeps the kernel file reference alive until that thread
+            # wakes — which, with no recv timeout, is never); a real
+            # process death closes everything at kernel exit.
+            ctrl._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             ctrl._sock.close()
         except OSError:
             pass
+
+    def wedge_rank(self, rank: int):
+        """SIGSTOP analog: the rank's control plane freezes (no
+        heartbeats, no downlink processing) but every socket stays
+        open — only coordinator liveness can detect it."""
+        self.runtimes[rank].controller.debug_wedge(True)
+
+    def sever_rank(self, rank: int):
+        """Transient TCP drop: abruptly close the rank's control
+        socket while the rank itself stays healthy — the reconnecting
+        channel must resume the session inside the grace window."""
+        self.runtimes[rank].controller.debug_sever()
+
+    def watch_fatal(self):
+        """Register a fatal listener on every runtime; returns
+        {rank: monotonic-time-of-first-fatal} (filled in as survivors
+        learn the world broke — the drill's detection clock)."""
+        times = {}
+        lock = threading.Lock()
+        for r, rt in enumerate(self.runtimes):
+            def listener(err, _r=r):
+                with lock:
+                    times.setdefault(_r, time.monotonic())
+            rt.add_fatal_listener(listener)
+        return times
 
     def submit(self, rank: int, request: Request,
                entry: TensorTableEntry):
@@ -609,7 +656,7 @@ def run_schedule(schedule: dict, ranks: int, n_ops: int,
 # ---------------------------------------------------------------------------
 
 def run_replay_kill_drill(ranks: int = 8, seed: int = 0,
-                          warm_ops: int = 14, post_ops: int = 6,
+                          warm_ops: int = 18, post_ops: int = 6,
                           hang_timeout_s: float = 20.0,
                           stall_shutdown_s: float = 2.0,
                           recovery_budget_s: float = 60.0) -> dict:
@@ -640,12 +687,21 @@ def run_replay_kill_drill(ranks: int = 8, seed: int = 0,
     world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
                        exchange_timeout_s=2 * stall_shutdown_s)
     engaged_per_rank = [False] * ranks
+    probed = [False] * ranks
 
     def rank_loop(rank: int):
         for i in range(warm_ops + post_ops):
             if rank == victim and i == warm_ops:
                 # Deterministic mid-replay death: the victim has
-                # replayed at least one full cycle by now.
+                # replayed at least one full cycle by now.  Wait
+                # (python-side only — no protocol traffic) until every
+                # rank has recorded its engagement probe: the kill's
+                # AB notice lands instantly and would otherwise fail a
+                # slow rank's LAST warm step before it could probe.
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and \
+                        not all(probed):
+                    time.sleep(0.01)
                 with record_lock:
                     failures.append({"t": time.monotonic(),
                                      "rank": rank, "op": i,
@@ -670,6 +726,7 @@ def run_replay_kill_drill(ranks: int = 8, seed: int = 0,
                     engaged_per_rank[rank] = bool(
                         world.runtimes[rank].replay is not None and
                         world.runtimes[rank].replay.stats()["active"])
+                    probed[rank] = True
             except HangError as e:
                 with record_lock:
                     hangs.append({"rank": rank, "op": i,
@@ -968,6 +1025,399 @@ def run_checkpoint_drill(mode: str, ranks: int = 4, seed: int = 0,
     return record
 
 
+# ---------------------------------------------------------------------------
+# MTTR drill: detect -> restore -> resume, with a number on it
+# ---------------------------------------------------------------------------
+
+def _percentile(values, q):
+    """Nearest-rank percentile of a list (None when empty)."""
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = min(len(vals) - 1, max(0, int(round(q / 100.0 *
+                                              (len(vals) - 1)))))
+    return vals[idx]
+
+
+def _mttr_grad(rank: int, step: int, shape) -> np.ndarray:
+    return np.full(shape, 0.25 * ((step % 5) + 1) + 0.01 * (rank + 1),
+                   np.float32)
+
+
+def _mttr_step_total(step: int, ranks: int) -> float:
+    """Closed-form allreduce(Sum) of every rank's _mttr_grad."""
+    return ranks * 0.25 * ((step % 5) + 1) + \
+        0.01 * (ranks * (ranks + 1) / 2.0)
+
+
+def _mttr_params_at(step: int, ranks: int, shape) -> np.ndarray:
+    p = np.zeros(shape, np.float32)
+    for s in range(step):
+        p += np.float32(_mttr_step_total(s, ranks))
+    return p
+
+
+def run_mttr_drill(fault: str = "kill", when: str = "idle",
+                   ranks: int = 8, seed: int = 0,
+                   liveness_interval_s: float = 0.4,
+                   steps_before: int = 10, post_steps: int = 12,
+                   commit_every: int = 2,
+                   hang_timeout_s: float = 20.0,
+                   stall_shutdown_s: float = 4.0,
+                   detect_budget_s: float = 10.0,
+                   commit_timeout_s: float = 3.0) -> dict:
+    """The self-healing control plane end to end, with wall-clock
+    numbers: ``ranks`` thread-ranks train a deterministic param vector
+    over the REAL control plane with liveness + reconnect armed,
+    checkpointing durably every ``commit_every`` steps; then one rank
+    suffers ``fault`` (kill = process death / wedge = SIGSTOP analog /
+    conn_drop = transient TCP drop) while the world is ``when``
+    (idle = nothing in flight — only heartbeats can expose the fault;
+    during_replay = steady-state schedules frozen; during_negotiation
+    = every cycle on the wire).  For kill/wedge the drill measures
+
+    * ``detect_s``   — fault to the LAST survivor's fatal unwind (the
+      liveness/grace bound, with no stall clock and no traffic),
+    * ``restore_s``  — ``restore_latest`` from the last committed
+      checkpoint,
+    * ``resume_s``   — teardown + re-formation + first post-restore
+      step (the in-process analog of elastic re-rendezvous),
+    * ``mttr_s``     — fault to first post-restore training step,
+
+    asserts the restored params are bit-identical to the closed-form
+    reference, the resumed world computes correct steps, and the
+    steady-state replay fast path re-engages.  For conn_drop the
+    assertion flips: the SAME world must resume transparently —
+    bit-identical results, zero HorovodInternalErrors, at least one
+    resumed reconnect."""
+    import tempfile
+
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        LocalCommitCoordinator)
+    from horovod_tpu.common import metrics as _hm
+    from horovod_tpu.common.elastic import RECOVERY_SECONDS
+
+    assert fault in ("kill", "wedge", "conn_drop"), fault
+    assert when in ("idle", "during_replay", "during_negotiation"), when
+    t0 = time.monotonic()
+    failpoints.reset()
+    rng = random.Random("%d|mttr|%s|%s" % (seed, fault, when))
+    victim = rng.randrange(1, ranks)
+    shape = (193,)
+    grace = 2.0 * liveness_interval_s
+    ckpt_dir = tempfile.mkdtemp(prefix="hvd-mttr-")
+    reconnects_c = _hm.REGISTRY.counter("hvd_reconnects_total")
+    resumed0 = reconnects_c.value(outcome="resumed")
+
+    name_phase = ["1"]
+
+    def names_for(step):
+        if when == "during_negotiation":
+            return "mttr.s%d" % step   # never converges: always wire
+        # The phase tag switches after a transient drop so the
+        # post-drop steps start as UNSEEN tensors: replay exits and
+        # the negotiation round trips prove the healed channel really
+        # carries traffic (a frozen schedule would pass wire-free).
+        return "mttr.%s.%s" % (name_phase[0], "ab"[step % 2])
+
+    record = {"kind": "mttr_drill", "fault": fault, "when": when,
+              "ranks": ranks, "seed": seed, "victim": victim,
+              "liveness_interval_s": liveness_interval_s,
+              "steps_before": steps_before, "commit_every": commit_every}
+    errors, results_bad, fatal_after_drop = [], [], []
+    world = world2 = None
+    try:
+        world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
+                           exchange_timeout_s=2 * stall_shutdown_s,
+                           liveness_interval_s=liveness_interval_s,
+                           reconnect_grace_s=grace)
+        fatal_times = world.watch_fatal()
+        coord = LocalCommitCoordinator()
+        mgrs = [CheckpointManager(ckpt_dir, rank=r, world_size=ranks,
+                                  coordinator=coord, keep=3,
+                                  commit_timeout_s=commit_timeout_s)
+                for r in range(ranks)]
+
+        fault_fired = threading.Event()
+        t_fault_box = {}
+
+        def fire_fault():
+            t_fault_box["t"] = time.monotonic()
+            if fault == "kill":
+                world.kill_rank(victim)
+            elif fault == "wedge":
+                world.wedge_rank(victim)
+            else:
+                world.sever_rank(victim)
+            fault_fired.set()
+
+        def train_loop(rank, start, stop_step, w, out_params,
+                       tolerate_failure):
+            params = np.array(out_params[rank], np.float32)
+            try:
+                for step in range(start, stop_step):
+                    if fault != "conn_drop" and fault_fired.is_set() \
+                            and rank == victim:
+                        return  # a dead/wedged rank stops stepping
+                    g = _mttr_grad(rank, step, shape)
+                    out = w.collective(rank, "allreduce",
+                                       names_for(step), g, step,
+                                       hang_timeout_s)
+                    expected = np.full(shape,
+                                       np.float32(_mttr_step_total(
+                                           step, ranks)), np.float32)
+                    if not np.allclose(out, expected, rtol=1e-5):
+                        results_bad.append({"rank": rank, "step": step})
+                        return
+                    params = params + out
+                    out_params[rank] = params
+                    if (step + 1) % commit_every == 0 and \
+                            rank < len(mgrs):
+                        # CheckFreq-style bounded staleness (see
+                        # run_checkpoint_drill): the previous save is
+                        # durable before the next starts.
+                        mgrs[rank].wait(2 * commit_timeout_s + 10)
+                        mgrs[rank].save_async(
+                            step + 1, {"obj/step": step + 1,
+                                       "tree/params": params.copy()})
+            except HangError as e:
+                errors.append({"rank": rank, "error": str(e)})
+            except Exception as e:
+                if not tolerate_failure:
+                    errors.append({"rank": rank,
+                                   "error": repr(e)[:300]})
+
+        # --- phase A: warm training (replay engages on fixed names) --
+        params_by_rank = {r: np.zeros(shape, np.float32)
+                          for r in range(ranks)}
+        threads = [threading.Thread(
+            target=train_loop, args=(r, 0, steps_before, world,
+                                     params_by_rank, False),
+            daemon=True) for r in range(ranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=steps_before * 2.0 + hang_timeout_s)
+            if t.is_alive():
+                errors.append({"rank": t.name, "error": "warm hang"})
+        for m in mgrs:
+            m.wait(timeout=2 * commit_timeout_s + 10)
+        committed = coord.committed_step()
+        record["committed_step"] = committed
+        if when == "during_replay":
+            record["replay_engaged_before"] = all(
+                rt.replay is not None and rt.replay.stats()["active"]
+                for rt in world.runtimes)
+
+        # --- fault + (for kill/wedge) detection ----------------------
+        if when == "idle":
+            fire_fault()
+        else:
+            # Fault lands while phase-B traffic is in flight.
+            threads = [threading.Thread(
+                target=train_loop,
+                args=(r, steps_before, steps_before + post_steps,
+                      world, params_by_rank, fault != "conn_drop"),
+                daemon=True) for r in range(ranks)]
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            fire_fault()
+            for t in threads:
+                t.join(timeout=post_steps * 2.0 + 2 * hang_timeout_s)
+                if t.is_alive():
+                    errors.append({"rank": t.name,
+                                   "error": "phase-B hang"})
+        t_fault = t_fault_box["t"]
+
+        if fault == "conn_drop":
+            # The drop may be invisible to training (replay needs no
+            # wire) — wait for the background resume itself, bounded
+            # by a couple of grace windows.
+            resume_deadline = time.monotonic() + 2 * grace + 2.0
+            while time.monotonic() < resume_deadline and \
+                    reconnects_c.value(outcome="resumed") <= resumed0:
+                time.sleep(0.02)
+            if when == "idle":
+                # Now force real negotiation traffic THROUGH the
+                # healed channel: fresh tensor names exit any frozen
+                # schedule, so every rank round-trips the coordinator.
+                name_phase[0] = "2"
+                threads = [threading.Thread(
+                    target=train_loop,
+                    args=(r, steps_before, steps_before + post_steps,
+                          world, params_by_rank, False), daemon=True)
+                    for r in range(ranks)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=post_steps * 2.0 + hang_timeout_s)
+                    if t.is_alive():
+                        errors.append({"rank": t.name,
+                                       "error": "post-drop hang"})
+            # Transparent resume: same world, bit-identical results,
+            # zero HorovodInternalErrors, session actually resumed.
+            fatal_after_drop = sorted(fatal_times)
+            resumed = reconnects_c.value(outcome="resumed") - resumed0
+            expected_final = _mttr_params_at(
+                steps_before + post_steps, ranks, shape)
+            survivors_exact = all(
+                np.array_equal(params_by_rank[r], expected_final)
+                for r in range(ranks))
+            record.update({
+                "reconnects_resumed": resumed,
+                "fatal_events": fatal_after_drop,
+                "params_bit_identical": bool(survivors_exact),
+                "errors": errors, "results_bad": results_bad,
+                "ok": (not errors and not results_bad and
+                       not fatal_after_drop and resumed >= 1 and
+                       survivors_exact),
+            })
+            return record
+
+        # kill/wedge: every survivor must unwind via the fast dead-rank
+        # notice (AB), with no stall clock involved.
+        survivors = [r for r in range(ranks) if r != victim]
+        deadline = t_fault + detect_budget_s
+        while time.monotonic() < deadline and \
+                not all(r in fatal_times for r in survivors):
+            time.sleep(0.02)
+        missing = [r for r in survivors if r not in fatal_times]
+        detect_s = (max(fatal_times[r] for r in survivors) - t_fault) \
+            if not missing else None
+        record["detect_s"] = round(detect_s, 3) \
+            if detect_s is not None else None
+        record["detect_missing"] = missing
+        if detect_s is not None:
+            RECOVERY_SECONDS.observe(detect_s, phase="detect")
+
+        # --- recovery: teardown, re-form, restore, resume ------------
+        t_teardown = time.monotonic()
+        for m in mgrs:
+            m.close(timeout=1.0)
+        world.close()
+        world = None
+        world2 = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
+                            exchange_timeout_s=2 * stall_shutdown_s,
+                            liveness_interval_s=liveness_interval_s,
+                            reconnect_grace_s=grace)
+        t_restore = time.monotonic()
+        restore_mgr = CheckpointManager(ckpt_dir, rank=0, world_size=1)
+        try:
+            restored_step, items = restore_mgr.restore_latest()
+        finally:
+            restore_mgr.close(timeout=1.0)
+        restore_s = time.monotonic() - t_restore
+        RECOVERY_SECONDS.observe(restore_s, phase="restore")
+        restored = items["tree/params"]
+        expected = _mttr_params_at(restored_step, ranks, shape)
+        bit_identical = bool(np.array_equal(restored, expected)) and \
+            restored.dtype == expected.dtype
+
+        first_step_done = {}
+        done_lock = threading.Lock()
+        post_params = {r: np.array(restored, np.float32)
+                       for r in range(ranks)}
+
+        def resume_loop(rank):
+            params = post_params[rank]
+            try:
+                for step in range(restored_step,
+                                  restored_step + post_steps):
+                    g = _mttr_grad(rank, step, shape)
+                    out = world2.collective(
+                        rank, "allreduce", "mttr.%s" % ("ab"[step % 2]),
+                        g, 10 ** 6 + step, hang_timeout_s)
+                    if step == restored_step:
+                        with done_lock:
+                            first_step_done[rank] = time.monotonic()
+                    expected_t = np.full(
+                        shape, np.float32(_mttr_step_total(step,
+                                                           ranks)),
+                        np.float32)
+                    if not np.allclose(out, expected_t, rtol=1e-5):
+                        results_bad.append({"rank": rank,
+                                            "step": step,
+                                            "phase": "resume"})
+                        return
+                    params = params + out
+                post_params[rank] = params
+            except Exception as e:
+                errors.append({"rank": rank, "phase": "resume",
+                               "error": repr(e)[:300]})
+
+        threads = [threading.Thread(target=resume_loop, args=(r,),
+                                    daemon=True) for r in range(ranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=post_steps * 2.0 + 2 * hang_timeout_s)
+            if t.is_alive():
+                errors.append({"rank": t.name, "error": "resume hang"})
+        replay_reengaged = all(
+            rt.replay is not None and rt.replay.stats()["active"]
+            for rt in world2.runtimes)
+        mttr_s = (max(first_step_done.values()) - t_fault) \
+            if len(first_step_done) == ranks else None
+        resume_s = (max(first_step_done.values()) - t_teardown) \
+            if len(first_step_done) == ranks else None
+        if resume_s is not None:
+            RECOVERY_SECONDS.observe(resume_s, phase="resume")
+        record.update({
+            "restored_step": restored_step,
+            "bit_identical": bit_identical,
+            "restore_s": round(restore_s, 4),
+            "resume_s": round(resume_s, 3)
+            if resume_s is not None else None,
+            "mttr_s": round(mttr_s, 3) if mttr_s is not None else None,
+            "replay_reengaged": replay_reengaged,
+            "errors": errors, "results_bad": results_bad,
+            "ok": (detect_s is not None and bit_identical and
+                   mttr_s is not None and replay_reengaged and
+                   not errors and not results_bad),
+        })
+        return record
+    finally:
+        for w in (world, world2):
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        record["elapsed_s"] = round(time.monotonic() - t0, 3)
+
+
+def run_mttr_matrix(ranks: int = 8, seed: int = 0,
+                    faults=("kill", "wedge", "conn_drop"),
+                    whens=("idle", "during_replay",
+                           "during_negotiation")) -> dict:
+    """The full fault x phase MTTR matrix; returns per-cell records
+    plus detect/MTTR percentiles for the artifact."""
+    t0 = time.monotonic()
+    cells = []
+    for fault in faults:
+        for when in whens:
+            logger.info("mttr drill: %s x %s", fault, when)
+            cells.append(run_mttr_drill(fault=fault, when=when,
+                                        ranks=ranks, seed=seed))
+    mttrs = [c["mttr_s"] for c in cells if c.get("mttr_s") is not None]
+    detects = [c["detect_s"] for c in cells
+               if c.get("detect_s") is not None]
+    return {
+        "kind": "mttr_matrix", "ranks": ranks, "seed": seed,
+        "cells": cells,
+        "mttr_s": {"p50": _percentile(mttrs, 50),
+                   "p90": _percentile(mttrs, 90),
+                   "max": max(mttrs) if mttrs else None},
+        "detect_s": {"p50": _percentile(detects, 50),
+                     "p90": _percentile(detects, 90),
+                     "max": max(detects) if detects else None},
+        "ok": all(c.get("ok") for c in cells),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
 def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
              n_ops: int = 30, hang_timeout_s: float = 30.0,
              stall_shutdown_s: float = 4.0,
@@ -1008,6 +1458,8 @@ def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
         "checkpoint_drill": drills or None,
         "recovery_latency": {
             "count": len(latencies),
+            "p50_s": _percentile(latencies, 50),
+            "p90_s": _percentile(latencies, 90),
             "max_s": max(latencies) if latencies else None,
             "histogram": hist.snapshot() or None,
         },
@@ -1030,12 +1482,27 @@ def main(argv=None) -> int:
     parser.add_argument("--no-ckpt-drill", action="store_true",
                         help="skip the checkpoint kill-and-resume "
                              "drills")
+    parser.add_argument("--mttr", action="store_true",
+                        help="run the MTTR drill matrix (kill/wedge/"
+                             "transient-drop x idle/during-replay/"
+                             "during-negotiation) instead of the "
+                             "fault-schedule soak")
     parser.add_argument("--out", default=None,
                         help="write the JSON artifact here")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING)
+    if args.mttr:
+        report = run_mttr_matrix(ranks=args.ranks, seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        summary = {k: report[k] for k in ("ranks", "seed", "mttr_s",
+                                          "detect_s", "ok",
+                                          "elapsed_s")}
+        print("CHAOSJSON " + json.dumps(summary))
+        return 0 if report["ok"] else 1
     report = run_soak(ranks=args.ranks, schedules=args.schedules,
                       seed=args.seed, n_ops=args.ops,
                       hang_timeout_s=args.hang_timeout,
