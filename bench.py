@@ -955,6 +955,190 @@ hvd.shutdown()
 """
 
 
+_DLRM_WORKER_SRC = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common import metrics as _hm
+from horovod_tpu.models import (DLRMDense, bce_logits_loss,
+                                dlrm_tiny_config,
+                                synthetic_click_batch)
+from horovod_tpu.sparse import EmbeddingBag, ShardedEmbedding
+
+hvd.init()
+RANK, SIZE = hvd.rank(), hvd.size()
+BATCH = int(os.environ.get("BENCH_DLRM_BATCH", "32"))
+STEPS = int(os.environ.get("BENCH_DLRM_STEPS", "10"))
+CADENCE = int(os.environ.get("BENCH_DLRM_CKPT_EVERY", "5"))
+LR = 0.05
+
+cfg = dlrm_tiny_config()
+tables = [ShardedEmbedding("dlrm.t%d" % i, rows, cfg.embed_dim,
+                           seed=7 + i)
+          for i, rows in enumerate(cfg.table_rows)]
+bags = [EmbeddingBag(t, mode="mean") for t in tables]
+
+model = DLRMDense(cfg)
+rng0 = jax.random.PRNGKey(0)
+dense0 = np.zeros((BATCH, cfg.num_dense), np.float32)
+emb0 = np.zeros((BATCH, cfg.num_tables * cfg.embed_dim), np.float32)
+params = jax.jit(lambda r, d, e: model.init(r, d, e))(
+    rng0, dense0, emb0)
+
+
+def loss_fn(params, dense_x, emb_in, labels):
+    return bce_logits_loss(model.apply(params, dense_x, emb_in),
+                           labels)
+
+
+grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 2)))
+flat_tmpl = None
+
+
+def one_step(step_idx):
+    # Per-rank, per-step batch: splits legitimately vary every step —
+    # the traffic pattern steady-state replay must never freeze.
+    global params, flat_tmpl
+    rng = np.random.default_rng([RANK, step_idx])
+    dense_x, ids, offsets, labels = synthetic_click_batch(
+        rng, BATCH, cfg)
+    embs = [bag.forward(ids[i], offsets)
+            for i, bag in enumerate(bags)]        # alltoall x2/table
+    emb_in = np.concatenate(embs, axis=1)
+    loss, (gparams, gemb) = grad_fn(params, dense_x, emb_in, labels)
+    flat, tree = jax.flatten_util.ravel_pytree(gparams)
+    flat = np.asarray(flat)
+    flat = np.asarray(hvd.allreduce(flat, op=hvd.Average,
+                                    name="dlrm.densegrad"))
+    gparams = tree(jax.numpy.asarray(flat))
+    params = jax.tree_util.tree_map(lambda p, g: p - LR * g,
+                                    params, gparams)
+    gemb = np.asarray(gemb)
+    for i, bag in enumerate(bags):               # alltoall x1/table
+        bag.backward(gemb[:, i * cfg.embed_dim:
+                          (i + 1) * cfg.embed_dim], lr=LR)
+    return float(loss)
+
+
+import jax.flatten_util  # noqa: E402  (after jax config)
+
+# Warmup: negotiation + jit compile.
+for s in range(3):
+    one_step(s)
+
+def _a2a_bytes():
+    c = (_hm.snapshot()["counters"]
+         .get("hvd_sparse_alltoall_bytes_total") or {})
+    return sum(c.values()) if isinstance(c, dict) else float(c)
+
+chunks, losses = [], []
+per = max(STEPS // 3, 1)
+sidx = 3
+for _ in range(3):
+    b0 = _a2a_bytes()
+    t0 = time.perf_counter()
+    for _ in range(per):
+        losses.append(one_step(sidx))
+        sidx += 1
+    dt = time.perf_counter() - t0
+    chunks.append({"steps_per_sec": per / dt,
+                   "alltoall_gbps": (_a2a_bytes() - b0) / dt / 2**30})
+chunks.sort(key=lambda c: c["steps_per_sec"])
+mid = chunks[len(chunks) // 2]
+
+# --- differential checkpoint cost, rank 0 (single-rank manager:
+# static runs have no rendezvous KV for the cross-process arbiter;
+# the per-shard byte ratio is what the lane gates on).
+ckpt = None
+if RANK == 0:
+    import shutil, tempfile
+    from horovod_tpu.checkpoint import CheckpointManager
+    cdir = tempfile.mkdtemp(prefix="hvd-dlrm-ckpt-")
+    mgr = CheckpointManager(cdir, rank=0, world_size=1, keep=4)
+    dense_np = {"dense/p%d" % i: np.asarray(l) for i, l in
+                enumerate(jax.tree_util.tree_leaves(params))}
+    local = {}
+    for t in tables:
+        local.update(t.durable_items(full=True))
+        t.clear_touched()
+    t0 = time.perf_counter()
+    mgr.save(1, dense_np, local_items=local)
+    full_ms = (time.perf_counter() - t0) * 1e3
+    full_bytes = sum(
+        os.path.getsize(os.path.join(r, f))
+        for r, _, fs in os.walk(cdir) for f in fs)
+# CADENCE more steps on every rank (collective), then the delta.
+for _ in range(CADENCE):
+    losses.append(one_step(sidx))
+    sidx += 1
+if RANK == 0:
+    try:
+        touched = sum(t.touched_count() for t in tables)
+        local = {}
+        for t in tables:
+            local.update(t.durable_items(full=False))
+        t0 = time.perf_counter()
+        mgr.save(2, dense_np, local_items=local,
+                 delta_of=mgr.delta_plan())
+        delta_ms = (time.perf_counter() - t0) * 1e3
+        total_bytes = sum(
+            os.path.getsize(os.path.join(r, f))
+            for r, _, fs in os.walk(cdir) for f in fs)
+        delta_bytes = total_bytes - full_bytes
+        # Round-trip check: base+delta must replay to exactly this
+        # rank's live shard (full-table assembly needs every rank's
+        # shard, which a static run's single-rank manager lacks).
+        step, items = mgr.restore_latest()
+        from horovod_tpu.checkpoint import RowDelta
+        ok = all(
+            items[t.item_name()] == RowDelta(t.local_ids, t.local,
+                                             t.num_rows)
+            for t in tables)
+        mgr.close()
+        ckpt = {
+            "full_save_ms": round(full_ms, 2),
+            "delta_save_ms": round(delta_ms, 2),
+            "full_bytes": full_bytes,
+            "delta_bytes": delta_bytes,
+            "delta_vs_full_bytes_ratio":
+                round(delta_bytes / full_bytes, 4),
+            "touched_rows": touched,
+            "table_rows_per_rank":
+                sum(len(t.local_ids) for t in tables),
+            "cadence_steps": CADENCE,
+            "roundtrip_bit_identical": bool(ok),
+        }
+    finally:
+        shutil.rmtree(cdir, ignore_errors=True)
+
+snap = hvd.metrics_snapshot()
+if RANK == 0:
+    counters = snap.get("counters", {})
+    print("BENCHJSON " + json.dumps({
+        "nproc": SIZE, "batch_per_rank": BATCH,
+        "tables": [{"rows": r, "dim": cfg.embed_dim}
+                   for r in cfg.table_rows],
+        "steps_per_sec": round(mid["steps_per_sec"], 3),
+        "steps_per_sec_spread": [
+            round(chunks[0]["steps_per_sec"], 3),
+            round(chunks[-1]["steps_per_sec"], 3)],
+        "alltoall_gbps": round(mid["alltoall_gbps"], 4),
+        "loss_first": round(losses[0], 4),
+        "loss_last": round(losses[-1], 4),
+        "checkpoint": ckpt,
+        "sparse_alltoall": {
+            "ops": counters.get("hvd_sparse_alltoall_ops_total"),
+            "bytes": counters.get("hvd_sparse_alltoall_bytes_total")},
+        "steady_state_exits":
+            counters.get("hvd_steady_state_exits"),
+        "metrics": snap,
+    }))
+hvd.shutdown()
+"""
+
+
 def _free_ports(n):
     import socket
     socks, ports = [], []
@@ -1051,6 +1235,129 @@ def bench_scale(args, smoke: bool) -> dict:
     if args.only != "scale":
         data.pop("metrics", None)
     return data
+
+
+def bench_dlrm(args, smoke: bool) -> dict:
+    """The recsys/DLRM-tiny lane at 8 CPU worker ranks (ROADMAP open
+    item 5): model-parallel sharded embedding tables exchanged through
+    the splits-piggybacking alltoall + a data-parallel dense MLP
+    allreduced per step — the first benched workload whose hot loop is
+    alltoall-dominated and whose splits change every step (the traffic
+    steady-state replay legally cannot freeze).  Reports steps/s,
+    per-rank alltoall GB/s, and the differential-checkpoint cost:
+    full-base vs touched-rows-delta save latency and the
+    delta_vs_full_bytes_ratio the Check-N-Run compression claim is
+    gated on."""
+    nproc = int(os.environ.get("HOROVOD_BENCH_DLRM_RANKS", "8"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    coord_port, ctrl_port = _free_ports(2)
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(nproc),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(nproc),
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_TPU_COORDINATOR": "127.0.0.1:%d" % coord_port,
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1:%d" % ctrl_port,
+            "HOROVOD_TPU_FORCE_CPU": "1",
+            "BENCH_DLRM_STEPS": "9" if smoke else "24",
+            "PYTHONPATH": repo,
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _DLRM_WORKER_SRC], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+    for rc, out in zip((p.returncode for p in procs), outs):
+        if rc != 0:
+            return {"error": "worker rc=%s: %s" % (rc, out[-800:])}
+    for line in outs[0].splitlines():
+        if line.startswith("BENCHJSON "):
+            data = json.loads(line[len("BENCHJSON "):])
+            data["platform"] = "cpu"
+            if args.only != "dlrm":
+                data.pop("metrics", None)
+            return data
+    return {"error": "no result line: %s" % outs[0][-800:]}
+
+
+def _load_prior_dlrm(repo_dir: str):
+    """Prior round's dlrm_tiny headline (same artifact walk as the
+    smoke lane; older rounds predate the lane and simply miss)."""
+    import glob
+    arts = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                data = json.loads(f.read())
+        except (OSError, ValueError):
+            continue
+        candidates = []
+        if isinstance(data, dict):
+            if isinstance(data.get("parsed"), dict):
+                candidates.append(data["parsed"])
+            candidates.append(data)
+        for d in candidates:
+            sec = d.get("dlrm_tiny")
+            if isinstance(sec, dict) and sec.get("steps_per_sec"):
+                spread = sec.get("steps_per_sec_spread") or [0, 0]
+                lo, hi = float(spread[0] or 0), float(spread[-1] or 0)
+                mid = float(sec["steps_per_sec"])
+                return {"steps_per_sec": mid,
+                        "spread_pct": (hi - lo) / mid * 100.0
+                        if mid and hi >= lo else 0.0,
+                        "source": os.path.basename(path)}
+    return None
+
+
+def check_dlrm_regression(out: dict, repo_dir: str):
+    """Warn when the DLRM lane's steps/s regresses beyond measured
+    noise vs the prior round, and record delta_vs_full_bytes_ratio in
+    the comparison so the compression claim stays artifact-gated
+    round over round (same mechanism as the smoke/recovery lanes)."""
+    cur = out.get("dlrm_tiny") or {}
+    cur_sps = cur.get("steps_per_sec")
+    if not cur_sps:
+        return
+    spread = cur.get("steps_per_sec_spread") or [0, 0]
+    cur_spread_pct = ((float(spread[-1]) - float(spread[0]))
+                      / cur_sps * 100.0) if cur_sps else 0.0
+    cmp = {"delta_vs_full_bytes_ratio":
+           (cur.get("checkpoint") or {}).get(
+               "delta_vs_full_bytes_ratio")}
+    prior = _load_prior_dlrm(repo_dir)
+    if prior is not None and prior["steps_per_sec"]:
+        tol_pct = max(cur_spread_pct, prior["spread_pct"], 10.0)
+        delta_pct = (cur_sps - prior["steps_per_sec"]) \
+            / prior["steps_per_sec"] * 100.0
+        cmp.update({
+            "prior_steps_per_sec": prior["steps_per_sec"],
+            "prior_source": prior["source"],
+            "delta_pct": round(delta_pct, 1),
+            "tolerance_pct": round(tol_pct, 1),
+            "regressed": delta_pct < -tol_pct,
+        })
+        if cmp["regressed"]:
+            print("WARNING: DLRM lane regressed %.1f%% vs %s "
+                  "(%.2f -> %.2f steps/s), beyond the %.1f%% noise "
+                  "band" % (-delta_pct, prior["source"],
+                            prior["steps_per_sec"], cur_sps, tol_pct),
+                  file=sys.stderr)
+    ratio = cmp["delta_vs_full_bytes_ratio"]
+    if ratio is not None and ratio > 0.1:
+        print("WARNING: delta_vs_full_bytes_ratio %.3f exceeds the "
+              "0.1 differential-checkpoint target at the DLRM-tiny "
+              "touch rate" % ratio, file=sys.stderr)
+    out["dlrm_vs_prior"] = cmp
 
 
 LAST_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1329,7 +1636,7 @@ def main():
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
                         "collectives", "checkpoint", "scale",
-                        "recovery"],
+                        "recovery", "dlrm"],
                    default=None)
     args = p.parse_args()
 
@@ -1383,7 +1690,7 @@ def main():
 
     run = {args.only} if args.only else {"resnet", "bert", "keras",
                                      "collectives", "checkpoint",
-                                     "scale", "recovery"}
+                                     "scale", "recovery", "dlrm"}
 
     resnet = {}
     if "resnet" in run:
@@ -1445,6 +1752,13 @@ def main():
         except Exception as e:
             out["recovery"] = {"error": repr(e)[:300]}
         check_recovery_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "dlrm" in run:
+        try:
+            out["dlrm_tiny"] = bench_dlrm(args, args.smoke)
+        except Exception as e:
+            out["dlrm_tiny"] = {"error": repr(e)[:300]}
+        check_dlrm_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:

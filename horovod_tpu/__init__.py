@@ -39,6 +39,7 @@ from .ops import (Handle, allgather, allgather_async, allreduce,
                   reducescatter_async, synchronize)
 
 from . import parallel
+from . import sparse
 
 __all__ = [
     "__version__",
@@ -62,5 +63,5 @@ __all__ = [
     # exceptions
     "HorovodInternalError", "HostsUpdatedInterrupt",
     # subpackages
-    "parallel",
+    "parallel", "sparse",
 ]

@@ -35,9 +35,19 @@ see PAPERS.md):
   corruption.  Restoring at world size M from a checkpoint written at
   N reads the manifest's layout and redistributes the items — resize
   N→M→N round-trips exactly.
+* **Differential (delta) checkpoints** — a save may persist only the
+  table rows touched since the last committed step
+  (:class:`~.delta.RowDelta` items; ``CheckpointManager.delta_plan``
+  picks the parent), forming a periodic-full-base + bounded-delta
+  chain (``HOROVOD_CKPT_DELTA_CHAIN_MAX``).  Restore replays
+  base→…→tip under the same checksum/commit/fallback semantics, and
+  GC pins every kept step's ancestors.  This is what makes
+  recsys-scale (sparse-embedding-dominated) checkpoints feasible —
+  see ``horovod_tpu/sparse/`` and docs/sparse_embedding.md.
 * **Failpoints + metrics** — every stage carries a failpoint site
   (``ckpt.serialize`` / ``ckpt.shard_write`` / ``ckpt.shard_write.torn``
-  / ``ckpt.prepare`` / ``ckpt.manifest_publish`` / ``ckpt.restore``)
+  / ``ckpt.prepare`` / ``ckpt.manifest_publish`` / ``ckpt.restore`` /
+  ``ckpt.delta_write``)
   and the registry records save/restore latency histograms, bytes, and
   commit outcomes, so the chaos soak can kill ranks mid-write and
   assert recovery (tools/chaos_soak.py ``run_checkpoint_drill``).
@@ -47,6 +57,7 @@ See docs/checkpointing.md for the on-disk format and commit protocol.
 
 from .coordinator import (CommitCoordinator, KVCommitCoordinator,
                           LocalCommitCoordinator)
+from .delta import RowDelta, assemble_table
 from .elastic import DurableCheckpointer
 from .manager import (CheckpointError, CheckpointManager,
                       CheckpointNotFoundError)
@@ -61,5 +72,5 @@ __all__ = [
     "LocalCommitCoordinator", "KVCommitCoordinator",
     "DurableCheckpointer", "install_preemption_hook",
     "Manifest", "MANIFEST_NAME", "read_manifest", "step_dir",
-    "list_step_dirs",
+    "list_step_dirs", "RowDelta", "assemble_table",
 ]

@@ -23,6 +23,24 @@ fall back to the previous committed step when anything fails
 validation.  Restoring at world size M from an N-way checkpoint reads
 the manifest layout and merges the N shards — the caller re-shards by
 construction since the item dict is world-shape-independent.
+
+Differential checkpoints (Check-N-Run shape; see delta.py): a save
+may declare itself a *delta* over the newest committed step
+(``delta_of``), persisting only rows touched since then as
+:class:`~.delta.RowDelta` items plus whatever small dense items the
+caller passes in full.  The manifest records the chain link
+(``meta.delta_of`` / ``base_step`` / ``chain_len``); restore walks
+the chain to its base and replays the steps in order with the same
+per-shard checksum verification, so a corrupt link invalidates the
+tip exactly like a corrupt dense shard.  ``delta_plan()`` bounds the
+chain with ``HOROVOD_CKPT_DELTA_CHAIN_MAX`` and forces a full base
+after a world-size change; GC never reaps a kept step's ancestors.
+
+Rank-local items (``local_items``): model-parallel state (sharded
+embedding rows) is NOT replicated across ranks, so it cannot ride the
+round-robin item partition — each rank writes its ``local_items``
+(globally unique names, e.g. suffixed with the rank) into its own
+shard and the manifest layout is extended from the prepare marks.
 """
 
 import logging
@@ -32,8 +50,10 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import env as _env
 from ..common import failpoints as _fp
 from ..common import metrics
+from . import delta as _delta
 from . import manifest as _mf
 from . import shard_io
 from .coordinator import CommitCoordinator, LocalCommitCoordinator
@@ -68,14 +88,32 @@ _GC_REMOVED = metrics.counter(
     "hvd_ckpt_gc_removed_total", "Checkpoint step dirs removed by GC")
 _PENDING = metrics.gauge(
     "hvd_ckpt_pending_saves", "Snapshots captured but not yet durable")
+_DELTA_ROWS = metrics.counter(
+    "hvd_ckpt_delta_rows_total",
+    "Table rows persisted by differential (RowDelta) checkpoint items")
+_DELTA_BYTES = metrics.counter(
+    "hvd_ckpt_delta_bytes_total",
+    "Payload bytes of differential (RowDelta) checkpoint items")
+_DELTA_CHAIN = metrics.gauge(
+    "hvd_ckpt_delta_chain_len",
+    "Length of the committed delta chain (0 = tip is a full base)")
+_RESTORE_CHAIN_LINKS = metrics.histogram(
+    "hvd_ckpt_restore_chain_links",
+    "Steps replayed per restore (1 = plain full checkpoint)",
+    bounds=metrics.log_bounds(1.0, 2.0, 10))
 
 
 class _Pending:
-    __slots__ = ("step", "items", "done", "outcome", "error")
+    __slots__ = ("step", "items", "local_items", "delta_of", "done",
+                 "outcome", "error")
 
-    def __init__(self, step: int, items: Dict[str, object]):
+    def __init__(self, step: int, items: Dict[str, object],
+                 local_items: Optional[Dict[str, object]] = None,
+                 delta_of: Optional[int] = None):
         self.step = step
         self.items = items
+        self.local_items = local_items or {}
+        self.delta_of = delta_of
         self.done = threading.Event()
         self.outcome: Optional[str] = None
         self.error: Optional[BaseException] = None
@@ -123,17 +161,32 @@ class CheckpointManager:
     # ------------------------------------------------------------------
     # save pipeline
     # ------------------------------------------------------------------
-    def save_async(self, step: int, items: Dict[str, object]):
+    def save_async(self, step: int, items: Dict[str, object],
+                   local_items: Optional[Dict[str, object]] = None,
+                   delta_of: Optional[int] = None):
         """Enqueue a snapshot for durable write; returns after the
         host-side capture (a shallow reference copy — see module
-        docstring for why that is a stable view)."""
+        docstring for why that is a stable view).
+
+        ``items`` is the replicated dict (identical on every rank,
+        round-robin sharded).  ``local_items`` are THIS rank's
+        model-parallel items, written into its own shard regardless of
+        the partition; names must be globally unique.  ``delta_of``
+        declares the save a differential step over that committed
+        parent (use :meth:`delta_plan` to pick it) — every rank must
+        pass the same value or the commit is rejected."""
         t0 = time.perf_counter()
         if self._closed:
             raise CheckpointError("CheckpointManager is closed")
-        if not isinstance(items, dict) or not items:
+        if not isinstance(items, dict):
+            raise ValueError("checkpoint items must be a dict of "
+                             "name -> object")
+        if not items and not local_items:
             raise ValueError("checkpoint items must be a non-empty "
                              "dict of name -> object")
-        pending = _Pending(int(step), dict(items))
+        pending = _Pending(int(step), dict(items),
+                           dict(local_items or {}),
+                           None if delta_of is None else int(delta_of))
         with self._lock:
             superseded = self._queued
             self._queued = pending
@@ -151,10 +204,13 @@ class CheckpointManager:
         _SAVE_SECONDS.observe(time.perf_counter() - t0, phase="capture")
 
     def save(self, step: int, items: Dict[str, object],
-             timeout: Optional[float] = None) -> str:
+             timeout: Optional[float] = None,
+             local_items: Optional[Dict[str, object]] = None,
+             delta_of: Optional[int] = None) -> str:
         """Synchronous save; returns the outcome (``committed`` on the
         arbiter, ``prepared`` on other ranks).  Raises on failure."""
-        self.save_async(step, items)
+        self.save_async(step, items, local_items=local_items,
+                        delta_of=delta_of)
         if not self.wait(timeout):
             raise CheckpointError("checkpoint save timed out")
         outcome = self._outcomes.get(int(step))
@@ -247,13 +303,25 @@ class CheckpointManager:
         step, items = pending.step, pending.items
         layout = _mf.assign_shards(list(items), self.world_size)
         own = sorted(n for n, r in layout.items() if r == self.rank)
+        own_items = {n: items[n] for n in own}
+        own_items.update(pending.local_items)
         sdir = _mf.step_dir(self.directory, step)
         os.makedirs(sdir, exist_ok=True)
 
-        payload = shard_io.serialize_items({n: items[n] for n in own},
-                                           rank=self.rank)
+        if pending.delta_of is not None and _fp.ENABLED:
+            # Failpoint site: a differential save about to hit disk.
+            # crash() models a rank dying mid-delta-write — the chain
+            # tip must stay the last COMMITTED base+delta state, never
+            # a torn or partially-applied link.
+            _fp.maybe_fail("ckpt.delta_write", rank=self.rank)
+
+        payload = shard_io.serialize_items(own_items, rank=self.rank)
         _SAVE_SECONDS.observe(time.perf_counter() - t_start,
                               phase="serialize")
+        d_rows, d_bytes = _delta.delta_stats(own_items.values())
+        if d_rows or d_bytes:
+            _DELTA_ROWS.inc(d_rows)
+            _DELTA_BYTES.inc(d_bytes)
 
         t_w = time.perf_counter()
         fname = _mf.shard_name(self.rank, self.world_size)
@@ -263,7 +331,10 @@ class CheckpointManager:
         _SAVE_SECONDS.observe(time.perf_counter() - t_w, phase="write")
 
         entry = {"rank": self.rank, "filename": fname,
-                 "sha256": digest, "nbytes": nbytes, "items": own}
+                 "sha256": digest, "nbytes": nbytes,
+                 "items": sorted(own_items)}
+        if pending.delta_of is not None:
+            entry["delta_of"] = pending.delta_of
         self.coordinator.prepare(step, self.rank, entry)
 
         if self.rank != 0:
@@ -280,17 +351,117 @@ class CheckpointManager:
             _SAVE_SECONDS.observe(time.perf_counter() - t_c,
                                   phase="commit")
             return "failed"
+        # Chain agreement: a delta link is only valid when EVERY rank
+        # wrote against the same parent — a rank that raced a
+        # different delta_plan() answer (e.g. restored later and saw
+        # an older tip) would otherwise produce an unreplayable chain.
+        parents = {m.get("delta_of") for m in marks}
+        if len(parents) > 1 or parents != {pending.delta_of}:
+            logger.error(
+                "ckpt: step %d abandoned — ranks disagree on the "
+                "delta parent (%s)", step, sorted(
+                    parents, key=lambda p: (p is None, p)))
+            _SAVE_SECONDS.observe(time.perf_counter() - t_c,
+                                  phase="commit")
+            return "failed"
+        meta = {}
+        if pending.delta_of is not None:
+            try:
+                parent = _mf.read_manifest(
+                    _mf.step_dir(self.directory, pending.delta_of))
+            except (OSError, ValueError) as e:
+                # The parent vanished between delta_plan() and commit
+                # (GC race, external cleanup): committing would
+                # publish an unreplayable tip.
+                logger.error("ckpt: step %d abandoned — delta parent "
+                             "%d unreadable: %s", step,
+                             pending.delta_of, e)
+                _SAVE_SECONDS.observe(time.perf_counter() - t_c,
+                                      phase="commit")
+                return "failed"
+            pmeta = parent.meta or {}
+            meta = {"delta_of": pending.delta_of,
+                    "base_step": int(pmeta.get("base_step",
+                                               parent.step)),
+                    "chain_len": int(pmeta.get("chain_len", 0)) + 1}
+        # The manifest layout extends the replicated partition with
+        # every rank's local (model-parallel) items, straight from the
+        # prepare marks; the replicated names keep rank 0's layout so
+        # a rank that skipped an assigned item is still caught by the
+        # restore coverage check.
+        for m in marks:
+            for n in m.get("items", ()):
+                layout.setdefault(n, m["rank"])
         man = _mf.Manifest(step=step, world_size=self.world_size,
-                           shards=marks, layout=layout)
+                           shards=marks, layout=layout, meta=meta)
         _mf.write_manifest(sdir, man, rank=self.rank)
         self.coordinator.mark_committed(step)
+        _DELTA_CHAIN.set(float(meta.get("chain_len", 0)))
         _SAVE_SECONDS.observe(time.perf_counter() - t_c, phase="commit")
         _SAVE_SECONDS.observe(time.perf_counter() - t_start,
                               phase="total")
         self.gc()
-        logger.info("ckpt: step %d committed (%d ranks, %d items)",
-                    step, self.world_size, len(items))
+        logger.info(
+            "ckpt: step %d committed (%d ranks, %d items%s)", step,
+            self.world_size, len(items) + len(pending.local_items),
+            ", delta of %d" % pending.delta_of
+            if pending.delta_of is not None else "")
         return "committed"
+
+    # ------------------------------------------------------------------
+    # differential chain planning
+    # ------------------------------------------------------------------
+    def delta_plan(self) -> Optional[int]:
+        """The parent step the NEXT save may be a delta of, or None
+        when it must be a full base: no committed tip yet, the chain
+        already at ``HOROVOD_CKPT_DELTA_CHAIN_MAX`` links, or the tip
+        was written at a different world size (rank-local shard names
+        would not line up across the resize).  Every rank derives the
+        same answer from the same committed on-disk state — the commit
+        phase cross-checks anyway (see ``_write_one``)."""
+        chain_max = _env.ckpt_delta_chain_max()
+        if chain_max <= 0:
+            return None
+        steps = self.committed_steps()
+        if not steps:
+            return None
+        tip = steps[-1]
+        try:
+            man = _mf.read_manifest(_mf.step_dir(self.directory, tip))
+        except (OSError, ValueError):
+            return None
+        if man.world_size != self.world_size:
+            return None
+        meta = man.meta or {}
+        if int(meta.get("chain_len", 0)) + 1 > chain_max:
+            return None
+        return tip
+
+    def chain_of(self, step: int) -> List[int]:
+        """The steps restore will replay for ``step``, base first.
+        Raises :class:`CheckpointCorruptError` on a broken link
+        (missing/corrupt parent manifest, a cycle, or a chain longer
+        than any legal bound)."""
+        chain, seen = [], set()
+        cur: Optional[int] = step
+        while cur is not None:
+            if cur in seen or len(chain) > 100000:
+                raise shard_io.CheckpointCorruptError(
+                    "step %d: delta chain contains a cycle at %d"
+                    % (step, cur))
+            seen.add(cur)
+            chain.append(cur)
+            try:
+                man = _mf.read_manifest(
+                    _mf.step_dir(self.directory, cur))
+            except (OSError, ValueError) as e:
+                raise shard_io.CheckpointCorruptError(
+                    "step %d: chain link %d has no readable manifest: "
+                    "%s" % (step, cur, e))
+            parent = (man.meta or {}).get("delta_of")
+            cur = None if parent is None else int(parent)
+        chain.reverse()
+        return chain
 
     # ------------------------------------------------------------------
     # restore
@@ -302,11 +473,9 @@ class CheckpointManager:
         steps = self.committed_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: int) -> Dict[str, object]:
-        """Restore one step, verifying every shard against the
-        manifest.  Raises :class:`CheckpointCorruptError` /
-        ``ValueError`` / ``OSError`` when the step fails validation."""
-        t0 = time.perf_counter()
+    def _read_step_items(self, step: int) -> Dict[str, object]:
+        """One step's OWN items (no chain replay), verifying every
+        shard against the manifest."""
         sdir = _mf.step_dir(self.directory, step)
         man = _mf.read_manifest(sdir)
         items: Dict[str, object] = {}
@@ -328,8 +497,24 @@ class CheckpointManager:
                 "step %d: items %s in layout but in no shard"
                 % (step, sorted(uncovered)))
         _BYTES.inc(nbytes, direction="read")
+        return items
+
+    def restore(self, step: int) -> Dict[str, object]:
+        """Restore one step, verifying every shard against its
+        manifest.  A differential step replays its whole chain, base
+        first — RowDelta items merge row-wise, everything else is
+        replaced by the newer value — so the result is bit-identical
+        to what a full checkpoint at ``step`` would have stored.
+        Raises :class:`CheckpointCorruptError` / ``ValueError`` /
+        ``OSError`` when any link fails validation."""
+        t0 = time.perf_counter()
+        chain = self.chain_of(step)
+        items: Dict[str, object] = {}
+        for link in chain:
+            _delta.merge_items(items, self._read_step_items(link))
         _RESTORE_SECONDS.observe(time.perf_counter() - t0,
                                  phase="total")
+        _RESTORE_CHAIN_LINKS.observe(float(len(chain)))
         return items
 
     def restore_latest(self) -> Tuple[int, Dict[str, object]]:
@@ -355,16 +540,28 @@ class CheckpointManager:
     def gc(self, keep: Optional[int] = None):
         """Keep the newest ``keep`` committed steps; drop older ones
         and any uncommitted step dir older than the newest committed
-        step (abandoned two-phase leftovers)."""
+        step (abandoned two-phase leftovers).  A kept differential
+        step pins its whole chain: reaping a base would silently
+        invalidate every delta above it."""
         keep = self.keep if keep is None else keep
         if keep is None:
             return
         committed = self.committed_steps()
-        doomed = set(committed[:-keep] if keep > 0 else committed)
+        kept = committed[-keep:] if keep > 0 else []
+        protected = set(kept)
+        for step in kept:
+            try:
+                protected.update(self.chain_of(step))
+            except shard_io.CheckpointCorruptError:
+                # A broken chain offers nothing to protect; restore
+                # will fall back past this step anyway.
+                continue
+        doomed = set(committed) - protected
         if committed:
             newest = committed[-1]
             doomed.update(s for s in _mf.list_step_dirs(self.directory)
-                          if s < newest and s not in committed)
+                          if s < newest and s not in committed
+                          and s not in protected)
         for step in sorted(doomed):
             sdir = _mf.step_dir(self.directory, step)
             try:

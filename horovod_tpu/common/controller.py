@@ -98,11 +98,21 @@ def construct_response(name: str, msgs: List[Request], size: int,
                        f"{m.request_rank} sent {len(m.splits)} entries "
                        f"for a group of {group}.")
                 break
-            if any(s < 0 for s in m.splits) or sum(m.splits) != dim0:
+            if any(s < 0 for s in m.splits):
                 err = (f"Alltoall splits for tensor {name}: rank "
-                       f"{m.request_rank} splits {list(m.splits)} must "
-                       f"be non-negative and sum to the first "
-                       f"dimension ({dim0}).")
+                       f"{m.request_rank} sent negative splits "
+                       f"{list(m.splits)}.")
+                break
+            if sum(m.splits) != dim0:
+                # A ragged lookup batch is the common way to get here:
+                # name the rank and both sums so the off-by-N is
+                # visible without a debugger.
+                err = (f"Alltoall splits for tensor {name}: rank "
+                       f"{m.request_rank} splits {list(m.splits)} sum "
+                       f"to {sum(m.splits)} but must sum to the first "
+                       f"dimension ({dim0}); its tensor sends {dim0} "
+                       f"rows, its splits account for "
+                       f"{sum(m.splits)}.")
                 break
 
     if err is not None:

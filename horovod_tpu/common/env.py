@@ -82,6 +82,19 @@ HOROVOD_RECONNECT_GRACE = "HOROVOD_RECONNECT_GRACE"
 # and never identifies its rank is cut after this many seconds
 # (previously a hardcoded 30 s).
 HOROVOD_REGISTRATION_TIMEOUT = "HOROVOD_REGISTRATION_TIMEOUT"
+# Differential checkpoints: the longest base→tip delta chain before
+# the manager forces the next save to be a full base (bounds restore
+# replay cost and the blast radius of a corrupt base).  0 = deltas
+# disabled (every save is a full base).
+HOROVOD_CKPT_DELTA_CHAIN_MAX = "HOROVOD_CKPT_DELTA_CHAIN_MAX"
+CKPT_DELTA_CHAIN_MAX_DEFAULT = 8
+
+
+def ckpt_delta_chain_max() -> int:
+    """The delta-chain length bound, parsed freshly on every call
+    (bench lanes and drills sweep it per phase)."""
+    return max(0, env_int(HOROVOD_CKPT_DELTA_CHAIN_MAX,
+                          CKPT_DELTA_CHAIN_MAX_DEFAULT))
 
 
 def start_timeout(default: float = None) -> float:

@@ -303,8 +303,17 @@ class SteadyStateReplay:
             if self.active:
                 # Defensive: during full replay the coordinator is
                 # silent; any response frame means some rank negotiated
-                # — fall back before executing it.
-                self._exit_locked("frame_during_replay")
+                # — fall back before executing it.  Alltoall frames
+                # get their own exit label: per-step-varying splits
+                # are the EXPECTED steady-state-breaking traffic of
+                # the sparse/DLRM workload, and lumping them under the
+                # generic reason hides whether an exit storm is the
+                # embedding exchange (by design) or a genuinely
+                # diverged peer.
+                reason = "alltoall" if any(
+                    r.response_type == ResponseType.ALLTOALL
+                    for r, _ in delivered) else "frame_during_replay"
+                self._exit_locked(reason)
                 return
             if not self.enabled:
                 return  # dormant: don't accumulate delivery history

@@ -6,6 +6,8 @@ from .bert import (BertConfig, BertEncoder, BertForMaskedLM,
 from .gpt import (GPTConfig, GPTLMHeadModel, gpt2_medium_config,
                   gpt2_small_config, gpt_tiny_config, lm_loss)
 from .mnist import MnistCNN, MnistMLP, cross_entropy_loss
+from .dlrm import (DLRMConfig, DLRMDense, bce_logits_loss,
+                   dlrm_tiny_config, synthetic_click_batch)
 
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
@@ -15,4 +17,6 @@ __all__ = [
     "GPTConfig", "GPTLMHeadModel", "gpt2_small_config",
     "gpt2_medium_config", "gpt_tiny_config", "lm_loss",
     "MnistCNN", "MnistMLP", "cross_entropy_loss",
+    "DLRMConfig", "DLRMDense", "bce_logits_loss", "dlrm_tiny_config",
+    "synthetic_click_batch",
 ]

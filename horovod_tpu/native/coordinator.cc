@@ -368,11 +368,18 @@ Response construct_response(const std::string& name,
       int64_t sum = 0;
       bool neg = false;
       for (int64_t s : m.splits) { sum += s; neg = neg || s < 0; }
-      if (neg || sum != dim0) {
+      if (neg) {
         err = "Alltoall splits for tensor " + name + ": rank " +
-              std::to_string(m.rank) + " splits must be non-negative "
-              "and sum to the first dimension (" +
-              std::to_string(dim0) + ").";
+              std::to_string(m.rank) + " sent negative splits.";
+        break;
+      }
+      if (sum != dim0) {
+        // Wire parity with the Python coordinator: name the rank and
+        // both sums (ragged lookup batches hit this).
+        err = "Alltoall splits for tensor " + name + ": rank " +
+              std::to_string(m.rank) + " splits sum to " +
+              std::to_string(sum) + " but must sum to the first "
+              "dimension (" + std::to_string(dim0) + ").";
         break;
       }
     }

@@ -1,0 +1,406 @@
+"""Row-sharded embedding tables over the eager alltoall plane.
+
+Exchange protocol for one lookup (three alltoalls, splits piggybacked
+on the coordinator response each time):
+
+1. **ids**: each rank sorts its batch's row ids by owning rank
+   (stable, so per-owner order is deterministic) and alltoalls the
+   sorted ids with per-owner send counts as splits.  Every rank now
+   holds the ids its shard must serve, grouped by requesting rank.
+2. **rows**: owners gather the requested rows from their local slice
+   and alltoall them straight back with the RECEIVED splits — each
+   requester gets rows in exactly the order it sent ids, then undoes
+   its sort permutation.
+3. **grads** (backward): requesters route row gradients with the same
+   splits as (1); owners receive them aligned with the ids from (1)
+   and scatter-add locally (``np.add.at`` — duplicate ids in a batch
+   accumulate, matching dense embedding-gradient semantics).
+
+Ownership is round-robin (``owner = id % size``, ``slot = id //
+size``) so skewed id distributions still balance.  All exchanges ride
+``hvd.alltoall`` with explicit splits — the validated, recv-splits-
+piggybacking path — under per-table tensor names, so 8 ranks issuing
+lookups for several tables negotiate them like any other collective
+stream.
+
+Touched-row tracking: every local update stamps its slots with a
+fresh generation.  ``snapshot_touched()`` / ``durable_items()`` /
+``clear_touched()`` give the checkpoint layer the capture → commit →
+clear lifecycle: clear only after the save is durable, and a subset
+clear forgets only touches from at or before the snapshot — a row
+updated while its delta save was in flight, and a failed save's
+rows, both stay marked so the next delta still carries them.
+"""
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..common import metrics
+from ..checkpoint.delta import RowDelta, assemble_table
+
+logger = logging.getLogger("horovod_tpu.sparse")
+
+_A2A_OPS = metrics.counter(
+    "hvd_sparse_alltoall_ops_total",
+    "Alltoall exchanges issued by the sparse embedding engine, by "
+    "stage (ids/rows/grads)")
+_A2A_BYTES = metrics.counter(
+    "hvd_sparse_alltoall_bytes_total",
+    "Payload bytes sent by sparse embedding alltoalls, by stage")
+_LOOKUP_SECONDS = metrics.histogram(
+    "hvd_sparse_lookup_seconds",
+    "Wall time of ShardedEmbedding lookup/apply_gradients calls")
+
+
+def _hvd_rank_size() -> Tuple[int, int]:
+    from ..common import basics
+    return basics.rank(), basics.size()
+
+
+def _alltoall(tensor: np.ndarray, splits: np.ndarray, name: str
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    from ..ops import eager
+    out, recv = eager.alltoall(tensor, splits=splits, name=name)
+    return np.asarray(out), np.asarray(recv)
+
+
+class _LookupContext:
+    """Routing state one lookup leaves behind for its backward."""
+
+    __slots__ = ("perm", "send_counts", "recv_splits", "recv_slots",
+                 "n_ids")
+
+    def __init__(self, perm, send_counts, recv_splits, recv_slots,
+                 n_ids):
+        self.perm = perm
+        self.send_counts = send_counts
+        self.recv_splits = recv_splits
+        self.recv_slots = recv_slots
+        self.n_ids = n_ids
+
+
+class ShardedEmbedding:
+    """One embedding table, row-sharded across the Horovod world.
+
+    ``rank``/``size`` default to the live Horovod world; pass them
+    explicitly (with ``size=1``) to use the engine without ``hvd.init``
+    (unit tests, single-process trainers — lookups are then purely
+    local).  Row init is deterministic per (name, seed, row): every
+    world size materializes bit-identical tables, so elastic resizes
+    only need the checkpoint for *trained* state.
+    """
+
+    def __init__(self, name: str, num_rows: int, dim: int,
+                 rank: Optional[int] = None,
+                 size: Optional[int] = None,
+                 seed: int = 0, dtype=np.float32,
+                 init_scale: float = 0.01):
+        if num_rows <= 0 or dim <= 0:
+            raise ValueError("num_rows and dim must be positive")
+        if (rank is None) != (size is None):
+            raise ValueError("pass both rank and size or neither")
+        if rank is None:
+            rank, size = _hvd_rank_size()
+        if not 0 <= rank < size:
+            raise ValueError("rank %d outside world of %d"
+                             % (rank, size))
+        self.name = str(name)
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.rank = int(rank)
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        self.seed = int(seed)
+        # Round-robin ownership: global row id -> (id % size) owner,
+        # (id // size) local slot.
+        self._local_ids = np.arange(self.rank, self.num_rows,
+                                    self.size, dtype=np.int64)
+        self.local = self._init_rows(self._local_ids,
+                                     float(init_scale))
+        # Touch tracking is GENERATIONAL, not a boolean mask: each
+        # apply stamps its slots with a fresh generation, and a
+        # subset clear removes only slots not re-touched since the
+        # snapshot it came from — a row updated while its delta save
+        # was in flight stays marked for the next delta (a plain
+        # mask cannot tell pre- from post-snapshot touches and would
+        # silently drop such rows from the chain).
+        self._touch_gen = np.zeros(len(self._local_ids), np.int64)
+        self._gen = 0
+        self._snap_gen = 0
+        self._ctx: Optional[_LookupContext] = None
+        self._lock = threading.Lock()
+        self._call = 0
+
+    # ------------------------------------------------------------------
+    # init / addressing
+    # ------------------------------------------------------------------
+    def _init_rows(self, ids: np.ndarray, scale: float) -> np.ndarray:
+        """Deterministic, SEEKABLE per-(row, col) init: a splitmix64
+        hash of (seed, table, row*dim+col) mapped to uniform
+        [-scale, scale).  Counter-based, so a rank materializes ONLY
+        the rows it was asked for — O(len(ids)·dim), never
+        O(num_rows) — and every world size computes bit-identical
+        values for the same global row (sequential generators can't
+        seek, and generating the full table per rank to slice 1/size
+        of it defeats row-sharding at recsys scale)."""
+        table_seed = np.uint64(int.from_bytes(
+            self.name.encode()[:8].ljust(8, b"\0"), "little"))
+        ctr = (ids[:, None].astype(np.uint64)
+               * np.uint64(self.dim)
+               + np.arange(self.dim, dtype=np.uint64)[None, :])
+        with np.errstate(over="ignore"):
+            z = (ctr + np.uint64(self.seed)
+                 * np.uint64(0x9E3779B97F4A7C15) + table_seed)
+            z = (z + np.uint64(0x9E3779B97F4A7C15))
+            z = (z ^ (z >> np.uint64(30))) \
+                * np.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> np.uint64(27))) \
+                * np.uint64(0x94D049BB133111EB)
+            z = z ^ (z >> np.uint64(31))
+        u = (z >> np.uint64(11)).astype(np.float64) / float(2 ** 53)
+        return ((2.0 * u - 1.0) * scale).astype(self.dtype)
+
+    def owner_of(self, ids: np.ndarray) -> np.ndarray:
+        return (np.asarray(ids, np.int64) % self.size)
+
+    def slot_of(self, ids: np.ndarray) -> np.ndarray:
+        return (np.asarray(ids, np.int64) // self.size)
+
+    @property
+    def local_ids(self) -> np.ndarray:
+        return self._local_ids
+
+    # ------------------------------------------------------------------
+    # forward / backward
+    # ------------------------------------------------------------------
+    def _check_ids(self, ids: np.ndarray):
+        if ids.ndim != 1:
+            raise ValueError("lookup ids must be 1-D, got shape %s"
+                             % (ids.shape,))
+        if len(ids) and (ids.min() < 0 or ids.max() >= self.num_rows):
+            raise ValueError(
+                "lookup ids out of range [0, %d): min %d max %d"
+                % (self.num_rows, ids.min(), ids.max()))
+
+    def lookup(self, ids) -> np.ndarray:
+        """Gather rows for ``ids`` (any rank's rows) via the alltoall
+        exchange; returns ``(len(ids), dim)`` in input order.  EVERY
+        rank must call lookup for the same table in the same step
+        (splits may differ — that is the point), like any collective.
+        """
+        t0 = time.perf_counter()
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64))
+        self._check_ids(ids)
+        call = self._next_call()
+        if self.size == 1:
+            slots = self.slot_of(ids)
+            self._ctx = _LookupContext(None, None, None, slots,
+                                       len(ids))
+            out = self.local[slots].copy()
+            _LOOKUP_SECONDS.observe(
+                time.perf_counter() - t0, op="lookup")
+            return out
+        owners = self.owner_of(ids)
+        perm = np.argsort(owners, kind="stable")
+        send_ids = ids[perm]
+        send_counts = np.bincount(owners, minlength=self.size
+                                  ).astype(np.int64)
+        recv_ids, recv_splits = _alltoall(
+            send_ids, send_counts,
+            name="sparse.%s.ids.%d" % (self.name, call))
+        _A2A_OPS.inc(1, stage="ids")
+        _A2A_BYTES.inc(int(send_ids.nbytes), stage="ids")
+        recv_slots = self.slot_of(recv_ids)
+        served = self.local[recv_slots]
+        rows, _ = _alltoall(
+            np.ascontiguousarray(served),
+            np.asarray(recv_splits, np.int64),
+            name="sparse.%s.rows.%d" % (self.name, call))
+        _A2A_OPS.inc(1, stage="rows")
+        _A2A_BYTES.inc(int(served.nbytes), stage="rows")
+        out = np.empty((len(ids), self.dim), self.dtype)
+        out[perm] = rows
+        self._ctx = _LookupContext(perm, send_counts,
+                                   np.asarray(recv_splits, np.int64),
+                                   recv_slots, len(ids))
+        _LOOKUP_SECONDS.observe(
+            time.perf_counter() - t0, op="lookup")
+        return out
+
+    def apply_gradients(self, grad, lr: float = 0.01):
+        """Route ``grad`` — ``(len(ids), dim)`` w.r.t. the last
+        lookup's output — back to the owning ranks and apply a sparse
+        SGD update (``row -= lr * grad``; duplicate ids accumulate).
+        Marks every updated row touched."""
+        t0 = time.perf_counter()
+        ctx, self._ctx = self._ctx, None
+        if ctx is None:
+            raise RuntimeError(
+                "apply_gradients without a preceding lookup on table "
+                "%r" % self.name)
+        grad = np.ascontiguousarray(np.asarray(grad, self.dtype))
+        if grad.shape != (ctx.n_ids, self.dim):
+            raise ValueError(
+                "grad shape %s does not match last lookup (%d, %d)"
+                % (grad.shape, ctx.n_ids, self.dim))
+        if self.size == 1:
+            grad_recv, recv_slots = grad, ctx.recv_slots
+        else:
+            call = self._next_call()
+            grad_recv, _ = _alltoall(
+                grad[ctx.perm], ctx.send_counts,
+                name="sparse.%s.grads.%d" % (self.name, call))
+            _A2A_OPS.inc(1, stage="grads")
+            _A2A_BYTES.inc(int(grad.nbytes), stage="grads")
+            recv_slots = ctx.recv_slots
+        # Update stays in table dtype end to end: a float64 detour
+        # would round differently from the plain `table -= lr*g` a
+        # single-process trainer runs, breaking bit-identity checks.
+        upd = (lr * grad_recv).astype(self.dtype, copy=False)
+        np.subtract.at(self.local, recv_slots, upd)
+        self._gen += 1
+        self._touch_gen[recv_slots] = self._gen
+        _LOOKUP_SECONDS.observe(
+            time.perf_counter() - t0, op="apply_gradients")
+
+    def _next_call(self) -> int:
+        with self._lock:
+            self._call += 1
+            return self._call
+
+    # ------------------------------------------------------------------
+    # touched-row lifecycle (differential checkpoints)
+    # ------------------------------------------------------------------
+    def touched_count(self) -> int:
+        return int((self._touch_gen > 0).sum())
+
+    def snapshot_touched(self) -> np.ndarray:
+        """LOCAL slot indices touched since the last clear (sorted).
+        Also records the current touch generation: a later
+        ``clear_touched(slots)`` forgets only touches up to THIS
+        point, so updates that land while the save is in flight stay
+        marked."""
+        self._snap_gen = self._gen
+        return np.flatnonzero(self._touch_gen > 0)
+
+    def clear_touched(self, slots: Optional[np.ndarray] = None):
+        """Forget touched marks — call ONLY after the delta carrying
+        them is durably committed.  With ``slots`` (the most recent
+        ``snapshot_touched`` result), rows re-touched after that
+        snapshot stay marked for the next delta; without, everything
+        clears (use after a FULL base only)."""
+        if slots is None:
+            self._touch_gen[:] = 0
+            self._gen = 0
+            self._snap_gen = 0
+        else:
+            slots = np.asarray(slots, np.int64)
+            stale = slots[self._touch_gen[slots] <= self._snap_gen]
+            self._touch_gen[stale] = 0
+
+    # ------------------------------------------------------------------
+    # durable state (RowDelta items over the checkpoint pipeline)
+    # ------------------------------------------------------------------
+    def item_prefix(self) -> str:
+        return "sparse/%s/rows" % self.name
+
+    def item_name(self) -> str:
+        """This rank's checkpoint item name (its shard of the
+        table)."""
+        return "%s.r%05d" % (self.item_prefix(), self.rank)
+
+    def durable_items(self, full: bool) -> Dict[str, RowDelta]:
+        """This rank's checkpoint item: all owned rows (``full=True``,
+        a base) or only the touched ones (a delta).  Values are
+        copies — safe to hand to the async writer."""
+        if full:
+            ids, values = self._local_ids, self.local.copy()
+        else:
+            slots = self.snapshot_touched()
+            ids = self._local_ids[slots]
+            values = self.local[slots].copy()
+        return {self.item_name():
+                RowDelta(ids, values, self.num_rows)}
+
+    def load_durable_items(self, items: Dict[str, object]):
+        """Rebuild the local slice from restored checkpoint items —
+        written at ANY world size (N→M→N resize: the full table is
+        assembled from every historical shard's RowDelta, then
+        re-sliced by the current ownership map)."""
+        table = assemble_table(items, self.item_prefix(),
+                               dtype=self.dtype)
+        if table is None:
+            raise KeyError(
+                "no checkpoint items under %r" % self.item_prefix())
+        if table.shape != (self.num_rows, self.dim):
+            raise ValueError(
+                "restored table %r has shape %s, expected (%d, %d)"
+                % (self.name, table.shape, self.num_rows, self.dim))
+        self.local = np.ascontiguousarray(
+            table[self._local_ids]).astype(self.dtype)
+        self._touch_gen = np.zeros(len(self._local_ids), np.int64)
+        self._gen = 0
+        self._snap_gen = 0
+        self._ctx = None
+
+    def full_table(self, items: Optional[Dict[str, object]] = None
+                   ) -> np.ndarray:
+        """The complete table.  With ``items`` (a restored checkpoint
+        dict) it is assembled from shards; without, from the LIVE
+        local slices via an allgather-free alltoall-less path — only
+        valid at size 1 (tests); multi-rank callers should restore."""
+        if items is not None:
+            return assemble_table(items, self.item_prefix(),
+                                  dtype=self.dtype)
+        if self.size != 1:
+            raise RuntimeError(
+                "full_table() without items is single-rank only")
+        return self.local.copy()
+
+
+class EmbeddingBag:
+    """Sum/mean-pool looked-up rows per example (the DLRM bag shape).
+
+    ``offsets`` follow the torch EmbeddingBag convention: example i
+    owns ids[offsets[i]:offsets[i+1]].  The backward expands a bag
+    gradient back to per-id row gradients (mean divides by bag size).
+    """
+
+    def __init__(self, table: ShardedEmbedding, mode: str = "sum"):
+        if mode not in ("sum", "mean"):
+            raise ValueError("mode must be 'sum' or 'mean'")
+        self.table = table
+        self.mode = mode
+        self._sizes: Optional[np.ndarray] = None
+
+    def forward(self, ids, offsets) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        offsets = np.asarray(offsets, np.int64)
+        rows = self.table.lookup(ids)
+        sizes = np.diff(np.concatenate([offsets, [len(ids)]]))
+        if (sizes < 0).any():
+            raise ValueError("offsets must be non-decreasing")
+        self._sizes = sizes
+        seg = np.repeat(np.arange(len(offsets)), sizes)
+        out = np.zeros((len(offsets), self.table.dim),
+                       self.table.dtype)
+        np.add.at(out, seg, rows)
+        if self.mode == "mean":
+            out /= np.maximum(sizes, 1)[:, None]
+        return out
+
+    def backward(self, bag_grad, lr: float = 0.01):
+        """Expand the per-bag gradient to per-id gradients and apply
+        them through the table's alltoall backward."""
+        if self._sizes is None:
+            raise RuntimeError("backward before forward")
+        sizes, self._sizes = self._sizes, None
+        bag_grad = np.asarray(bag_grad, self.table.dtype)
+        if self.mode == "mean":
+            bag_grad = bag_grad / np.maximum(sizes, 1)[:, None]
+        row_grad = np.repeat(bag_grad, sizes, axis=0)
+        self.table.apply_gradients(row_grad, lr=lr)

@@ -66,6 +66,31 @@ def test_construct_response_rejects_bad_splits():
     assert "entries for a group" in resp.error_message
 
 
+def test_bad_splits_message_names_rank_and_both_sums():
+    """A ragged lookup batch (splits sum != dim0) must be attributable
+    from the message alone: the offending RANK, the actual splits sum,
+    and the expected first dimension all appear — previously the
+    actual sum was missing, leaving the off-by-N opaque."""
+    from horovod_tpu.common.controller import construct_response
+    from horovod_tpu.common.message import ResponseType
+    msgs = [_a2a_request(0, (5,), (2, 3)),
+            _a2a_request(1, (7,), (1, 2))]      # rank 1: sum 3 != 7
+    resp = construct_response("t", msgs, 2, set())
+    assert resp.response_type == ResponseType.ERROR
+    msg = resp.error_message
+    assert "rank 1" in msg, msg
+    assert "sum to 3" in msg, msg                 # actual
+    assert "first dimension (7)" in msg, msg      # expected
+    assert "[1, 2]" in msg, msg                   # the splits
+    # Negative splits get their own message, still naming the rank.
+    msgs = [_a2a_request(0, (5,), (2, 3)),
+            _a2a_request(1, (1,), (2, -1))]
+    resp = construct_response("t", msgs, 2, set())
+    assert resp.response_type == ResponseType.ERROR
+    assert "rank 1" in resp.error_message
+    assert "negative" in resp.error_message
+
+
 def test_alltoall_changing_splits_same_name():
     """The stale-matrix hazard the cache exclusion guards against: the
     SAME tensor name with different splits per call must return fresh

@@ -223,3 +223,54 @@ def test_smoke_regression_skips_zero_headline_prior(bench, tmp_path):
                                       "spread_pct": 3.0}}}))
     bench.check_smoke_regression(out, str(tmp_path))
     assert out["smoke_vs_prior"]["prior_images_per_sec"] == 25.0
+
+
+def test_dlrm_regression_warns_and_records_ratio(bench, tmp_path,
+                                                 capsys):
+    prior = {"dlrm_tiny": {"steps_per_sec": 20.0,
+                           "steps_per_sec_spread": [19.0, 21.0],
+                           "checkpoint": {
+                               "delta_vs_full_bytes_ratio": 0.02}}}
+    with open(tmp_path / "BENCH_r07.json", "w") as f:
+        json.dump(prior, f)
+    out = {"dlrm_tiny": {"steps_per_sec": 10.0,
+                         "steps_per_sec_spread": [9.5, 10.5],
+                         "checkpoint": {
+                             "delta_vs_full_bytes_ratio": 0.03}}}
+    bench.check_dlrm_regression(out, str(tmp_path))
+    cmp = out["dlrm_vs_prior"]
+    assert cmp["regressed"] is True
+    assert cmp["prior_source"] == "BENCH_r07.json"
+    assert cmp["delta_vs_full_bytes_ratio"] == 0.03
+    assert "DLRM lane regressed" in capsys.readouterr().err
+
+
+def test_dlrm_regression_without_prior_records_ratio_only(bench,
+                                                          tmp_path):
+    out = {"dlrm_tiny": {"steps_per_sec": 10.0,
+                         "checkpoint": {
+                             "delta_vs_full_bytes_ratio": 0.02}}}
+    bench.check_dlrm_regression(out, str(tmp_path))
+    assert out["dlrm_vs_prior"] == {"delta_vs_full_bytes_ratio": 0.02}
+
+
+def test_dlrm_regression_warns_on_ratio_above_target(bench, tmp_path,
+                                                     capsys):
+    out = {"dlrm_tiny": {"steps_per_sec": 10.0,
+                         "checkpoint": {
+                             "delta_vs_full_bytes_ratio": 0.4}}}
+    bench.check_dlrm_regression(out, str(tmp_path))
+    assert "exceeds the 0.1" in capsys.readouterr().err
+
+
+def test_dlrm_regression_inside_noise_is_silent(bench, tmp_path,
+                                                capsys):
+    prior = {"dlrm_tiny": {"steps_per_sec": 10.5,
+                           "steps_per_sec_spread": [10.0, 11.0]}}
+    with open(tmp_path / "BENCH_r07.json", "w") as f:
+        json.dump(prior, f)
+    out = {"dlrm_tiny": {"steps_per_sec": 10.0,
+                         "steps_per_sec_spread": [9.8, 10.2]}}
+    bench.check_dlrm_regression(out, str(tmp_path))
+    assert out["dlrm_vs_prior"]["regressed"] is False
+    assert "regressed" not in capsys.readouterr().err

@@ -1,0 +1,224 @@
+"""Sparse embedding engine (horovod_tpu/sparse/): ownership and init
+determinism, the three-alltoall lookup/grad exchange (bit-exact vs a
+serial reference at 4 ranks), embedding bags, the touched-row
+lifecycle, and the durable RowDelta items incl. cross-world-size
+reassembly."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.checkpoint import RowDelta, assemble_table
+from horovod_tpu.sparse import EmbeddingBag, ShardedEmbedding
+
+from multiproc import assert_all_ok, run_workers
+
+
+# ---------------------------------------------------------------------------
+# unit: no hvd runtime needed (explicit rank/size)
+# ---------------------------------------------------------------------------
+
+def test_round_robin_ownership_and_shard_determinism():
+    full = ShardedEmbedding("tbl", 100, 4, rank=0, size=1, seed=3)
+    shards = [ShardedEmbedding("tbl", 100, 4, rank=r, size=4, seed=3)
+              for r in range(4)]
+    for r, sh in enumerate(shards):
+        assert sh.local_ids.tolist() == list(range(r, 100, 4))
+        np.testing.assert_array_equal(sh.local, full.local[r::4])
+    # Different table name -> different init.
+    other = ShardedEmbedding("tbl2", 100, 4, rank=0, size=1, seed=3)
+    assert not np.array_equal(other.local, full.local)
+
+
+def test_single_rank_lookup_apply_and_duplicates():
+    t = ShardedEmbedding("u", 50, 3, rank=0, size=1, seed=1)
+    ids = np.array([4, 9, 4, 0])
+    rows = t.lookup(ids)
+    np.testing.assert_array_equal(rows, t.local[ids])
+    before = t.local.copy()
+    g = np.arange(12, dtype=np.float32).reshape(4, 3)
+    t.apply_gradients(g, lr=0.5)
+    exp = before.copy()
+    np.subtract.at(exp, ids, (0.5 * g).astype(np.float32))
+    np.testing.assert_array_equal(t.local, exp)   # dup id accumulated
+    assert sorted(t.local_ids[t.snapshot_touched()]) == [0, 4, 9]
+
+
+def test_apply_without_lookup_and_shape_errors():
+    t = ShardedEmbedding("v", 10, 2, rank=0, size=1)
+    with pytest.raises(RuntimeError, match="without a preceding"):
+        t.apply_gradients(np.zeros((1, 2)))
+    t.lookup(np.array([1]))
+    with pytest.raises(ValueError, match="grad shape"):
+        t.apply_gradients(np.zeros((2, 2)))
+    with pytest.raises(ValueError, match="out of range"):
+        t.lookup(np.array([10]))
+    with pytest.raises(ValueError, match="1-D"):
+        t.lookup(np.zeros((2, 2), np.int64))
+
+
+def test_embedding_bag_sum_and_mean():
+    t = ShardedEmbedding("bag", 20, 2, rank=0, size=1, seed=2)
+    ids = np.array([1, 3, 5, 7, 9])
+    offsets = np.array([0, 2, 2])          # bags: [1,3], [], [5,7,9]
+    bag = EmbeddingBag(t, mode="sum")
+    out = bag.forward(ids, offsets)
+    np.testing.assert_array_equal(out[0], t.local[1] + t.local[3])
+    np.testing.assert_array_equal(out[1], 0.0)
+    np.testing.assert_array_equal(
+        out[2], t.local[5] + t.local[7] + t.local[9])
+    before = t.local.copy()
+    bag.backward(np.ones((3, 2), np.float32), lr=1.0)
+    # mean mode divides both ways
+    t2 = ShardedEmbedding("bag", 20, 2, rank=0, size=1, seed=2)
+    bag2 = EmbeddingBag(t2, mode="mean")
+    out2 = bag2.forward(ids, offsets)
+    np.testing.assert_allclose(
+        out2[2], (t2.local[5] + t2.local[7] + t2.local[9]) / 3)
+    # backward routed rows: bag 0's grad hit rows 1 and 3
+    assert not np.array_equal(t.local[1], before[1])
+    assert not np.array_equal(t.local[3], before[3])
+    np.testing.assert_array_equal(t.local[2], before[2])
+
+
+def test_touched_lifecycle_clear_subset():
+    t = ShardedEmbedding("life", 30, 2, rank=0, size=1)
+    t.lookup(np.array([1, 2]))
+    t.apply_gradients(np.ones((2, 2), np.float32))
+    snap = t.snapshot_touched()
+    assert t.local_ids[snap].tolist() == [1, 2]
+    # New touches AFTER the snapshot survive a subset clear (the
+    # failed-save-keeps-rows contract) — INCLUDING a re-touch of a
+    # snapshotted row: its post-snapshot update is not yet in any
+    # durable delta, so forgetting it would corrupt the chain.
+    t.lookup(np.array([5, 1]))
+    t.apply_gradients(np.ones((2, 2), np.float32))
+    t.clear_touched(snap)
+    assert t.local_ids[t.snapshot_touched()].tolist() == [1, 5]
+    t.clear_touched()
+    assert t.touched_count() == 0
+
+
+def test_durable_items_full_delta_and_resize_reassembly():
+    """Shards written at world 4 reassemble at any world size; deltas
+    merged over the base replay to the live table (the N→M→N story at
+    the engine level)."""
+    shards = [ShardedEmbedding("rs", 40, 2, rank=r, size=4, seed=9)
+              for r in range(4)]
+    items = {}
+    for sh in shards:
+        items.update(sh.durable_items(full=True))
+        sh.clear_touched()
+    # Touch some rows on each shard (simulating applied grads).
+    for sh in shards:
+        slots = np.arange(0, len(sh.local_ids), 3)
+        sh.local[slots] += 1.5
+        sh._gen += 1
+        sh._touch_gen[slots] = sh._gen
+    deltas = {}
+    for sh in shards:
+        deltas.update(sh.durable_items(full=False))
+    merged = dict(items)
+    for name, d in deltas.items():
+        merged[name] = merged[name].merged_with(d)
+    expected = np.zeros((40, 2), np.float32)
+    for sh in shards:
+        expected[sh.local_ids] = sh.local
+    # Reassemble at world 2 (different shard count than the writer).
+    new = [ShardedEmbedding("rs", 40, 2, rank=r, size=2, seed=0)
+           for r in range(2)]
+    for sh in new:
+        sh.load_durable_items(merged)
+        np.testing.assert_array_equal(sh.local, expected[sh.local_ids])
+        assert sh.touched_count() == 0
+
+
+def test_load_durable_items_validation():
+    t = ShardedEmbedding("val", 10, 2, rank=0, size=1)
+    with pytest.raises(KeyError):
+        t.load_durable_items({})
+    wrong = {"sparse/val/rows.r00000":
+             RowDelta(np.arange(8), np.zeros((8, 2)), 8)}
+    with pytest.raises((ValueError, Exception)):
+        t.load_durable_items(wrong)
+
+
+def test_sparse_metrics_registered():
+    from horovod_tpu.common import metrics
+    t = ShardedEmbedding("met", 10, 2, rank=0, size=1)
+    t.lookup(np.array([1]))
+    snap = metrics.snapshot()
+    assert "hvd_sparse_lookup_seconds" in snap["histograms"]
+
+
+# ---------------------------------------------------------------------------
+# multi-rank: the real alltoall exchange
+# ---------------------------------------------------------------------------
+
+def test_lookup_exchange_bit_exact_at_4_ranks():
+    """Ragged per-rank batches for 2 tables over the real eager plane:
+    looked-up rows equal the serial reference exactly, sparse updates
+    land bit-identically on the owning shards, alltoall metrics count,
+    and touched rows mirror the update stream."""
+    results = run_workers("""
+from horovod_tpu.sparse import ShardedEmbedding
+from horovod_tpu.common import metrics as _m
+
+tables = [ShardedEmbedding("e2e.t%d" % i, 64, 3, seed=5 + i)
+          for i in range(2)]
+refs = [ShardedEmbedding("e2e.t%d" % i, 64, 3, rank=0, size=1,
+                         seed=5 + i) for i in range(2)]
+
+def batch(r, step, ti):
+    rng = np.random.default_rng([7 * r + ti, step])
+    n = int(rng.integers(1, 9))          # ragged: splits vary by rank
+    ids = rng.integers(0, 64, size=n)
+    g = rng.standard_normal((n, 3)).astype(np.float32)
+    return ids, g
+
+for step in range(4):
+    for ti, (t, ref) in enumerate(zip(tables, refs)):
+        ids, g = batch(RANK, step, ti)
+        rows = t.lookup(ids)
+        np.testing.assert_array_equal(rows, ref.local[ids])
+        t.apply_gradients(g, lr=0.1)
+        for r in range(SIZE):
+            rids, rg = batch(r, step, ti)
+            np.subtract.at(ref.local, rids,
+                           (0.1 * rg).astype(np.float32))
+for t, ref in zip(tables, refs):
+    np.testing.assert_array_equal(t.local, ref.local[t.local_ids])
+    touched = set(t.local_ids[t.snapshot_touched()].tolist())
+    expect_touched = set()
+    for step in range(4):
+        for r in range(SIZE):
+            ids, _ = batch(r, step, int(t.name[-1]))
+            expect_touched.update(
+                int(i) for i in ids if i % SIZE == RANK)
+    assert touched == expect_touched, (sorted(touched),
+                                       sorted(expect_touched))
+ops = _m.snapshot()["counters"]["hvd_sparse_alltoall_ops_total"]
+assert ops.get("stage=ids") == 8.0, ops      # 4 steps x 2 tables
+assert ops.get("stage=rows") == 8.0, ops
+assert ops.get("stage=grads") == 8.0, ops
+print("OK")
+""", nproc=4, timeout=240)
+    assert_all_ok(results)
+
+
+def test_bag_exchange_at_2_ranks():
+    results = run_workers("""
+from horovod_tpu.sparse import EmbeddingBag, ShardedEmbedding
+t = ShardedEmbedding("bag2", 32, 2, seed=11)
+ref = ShardedEmbedding("bag2", 32, 2, rank=0, size=1, seed=11)
+bag = EmbeddingBag(t, mode="sum")
+ids = np.array([RANK, RANK + 8, RANK + 16])
+offsets = np.array([0, 2])
+out = bag.forward(ids, offsets)
+np.testing.assert_array_equal(out[0], ref.local[ids[0]]
+                              + ref.local[ids[1]])
+np.testing.assert_array_equal(out[1], ref.local[ids[2]])
+bag.backward(np.ones((2, 2), np.float32), lr=1.0)
+assert t.touched_count() > 0
+print("OK")
+""", nproc=2, timeout=180)
+    assert_all_ok(results)

@@ -587,6 +587,119 @@ def _recv(conn, timeout=10.0):
     return frame
 
 
+# ---------------------------------------------------------------------------
+# alltoall exclusion (the sparse/DLRM traffic pattern)
+# ---------------------------------------------------------------------------
+
+def test_alltoall_request_never_eligible_and_resets_tracking():
+    """Submit-side: alltoall is structurally non-replayable (splits
+    legally vary per step); a cycle containing one never stabilizes."""
+    rt = _FakeRuntime()
+    rp = SteadyStateReplay(rt, warmup_cycles=2)
+    a2a = Request(request_rank=0, request_type=RequestType.ALLTOALL,
+                  tensor_name="sp.ids", tensor_shape=(5,),
+                  tensor_type=DataType.FLOAT32, splits=(2, 3))
+    assert not rp.eligible(a2a)
+    # Every cycle: one allreduce + one alltoall (as runtime.submit
+    # routes it: note_disruption with the request-type label).
+    for _ in range(8):
+        rp.observe_submit(_req("sp.dense"))
+        rp.on_responses("cb", [(_resp(["sp.dense"]), (0,))])
+        rp.note_disruption("alltoall")
+    assert not rp.active
+    assert rp.stats()["stable_cycles"] == 0
+
+
+def test_alltoall_frame_during_replay_exits_with_own_label():
+    """Delivery-side: an ALLTOALL response frame arriving while a rank
+    replays must exit with reason=alltoall (its own label), not the
+    generic frame_during_replay — the sparse workload's exits must be
+    attributable in hvd_steady_state_exits."""
+    rt = _FakeRuntime()
+    rp = SteadyStateReplay(rt, warmup_cycles=2)
+    names = ["a2f.x"]
+    for _ in range(3):
+        _drive_cycle(rp, names)
+    assert rp.active
+    c = metrics.REGISTRY.counter("hvd_steady_state_exits")
+    before = c.value(reason="alltoall")
+    a2a = Response(response_type=ResponseType.ALLTOALL,
+                   tensor_names=["sp.ids"],
+                   tensor_type=DataType.FLOAT32,
+                   tensor_sizes=[1, 1], tensor_shapes=[(2,)])
+    rp.on_responses("cb", [(a2a, ())])
+    assert not rp.active
+    assert c.value(reason="alltoall") == before + 1
+    # A non-alltoall frame keeps the generic label.
+    for _ in range(4):
+        _drive_cycle(rp, names)
+    assert rp.active
+    g0 = c.value(reason="frame_during_replay")
+    rp.on_responses("cb", [(_resp(["a2f.x"]), (0,))])
+    assert c.value(reason="frame_during_replay") == g0 + 1
+
+
+def test_alltoall_excluded_from_replay_at_8_ranks():
+    """8 real ranks: replay engages on a dense cycle; an alltoall
+    (uneven, per-rank-varying splits — the sharded-embedding exchange
+    shape) exits with reason=alltoall; cycles that keep containing
+    alltoall NEVER re-freeze; dropping it re-engages.  Results exact
+    throughout."""
+    body = """
+from horovod_tpu.common import metrics as _m, basics
+rt = basics._state().runtime
+assert rt.replay is not None
+c = _m.REGISTRY.counter
+buf = np.full((17,), float(RANK + 1), np.float32)
+expect = float(sum(range(1, SIZE + 1)))
+
+def dense(n):
+    for _ in range(n):
+        out = np.asarray(hvd.allreduce(buf, op=hvd.Sum, name="xa.t0"))
+        assert (out == expect).all(), out[0]
+
+def a2a(tag):
+    # rank R sends 1 or 2 rows to each dest: splits vary per rank.
+    splits = np.array([1 + (RANK + d) % 2 for d in range(SIZE)])
+    x = np.arange(splits.sum(), dtype=np.float32) + 1000.0 * RANK
+    y, recv = hvd.alltoall(x, splits=splits, name="xa.a2a." + tag)
+    exp_recv = [1 + (s + RANK) % 2 for s in range(SIZE)]
+    np.testing.assert_array_equal(np.asarray(recv), exp_recv)
+    assert np.asarray(y).shape[0] == sum(exp_recv)
+
+# Engage on the dense cycle.
+dense(12)
+assert rt.replay.stats()["active"]
+entries_before = c("hvd_steady_state_entries").value()
+
+# Submit-side exit while ACTIVE: alltoall carries its own label.
+a2a("first")
+assert c("hvd_steady_state_exits").value(reason="alltoall") >= 1
+assert not rt.replay.stats()["active"]
+
+# Cycles that contain an alltoall must never freeze again.
+for i in range(8):
+    dense(1)
+    a2a("loop%d" % i)
+assert not rt.replay.stats()["active"]
+assert c("hvd_steady_state_entries").value() == entries_before
+
+# Drop the alltoall: the dense cycle re-engages (the exclusion was
+# the alltoall, not collateral damage).
+dense(12)
+assert rt.replay.stats()["active"]
+assert c("hvd_steady_state_entries").value() > entries_before
+print("A2A_EXCLUSION_OK", RANK)
+hvd.shutdown()
+"""
+    results = run_workers(
+        body, nproc=8, timeout=300,
+        extra_env={"HOROVOD_STEADY_STATE_REPLAY": "1"})
+    assert_all_ok(results)
+    for _, out in results:
+        assert "A2A_EXCLUSION_OK" in out
+
+
 @pytest.mark.parametrize("kind", [k for k, _ in _coordinators()])
 def test_coalesced_frames_fuse_whole_cycles_at_8_ranks(kind):
     """One RQ frame carrying a whole 4-tensor cycle per rank must come

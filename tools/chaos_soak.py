@@ -848,17 +848,45 @@ def _drill_params_at(step: int, shape) -> np.ndarray:
     return p
 
 
+# --- delta-chain drill: the sparse table trained alongside params ---
+_DELTA_ROWS = 48
+_DELTA_DIM = 2
+_DELTA_PREFIX = "sparse/tbl/rows"
+
+
+def _delta_touched_rows(step: int):
+    """Global rows the whole world touches at ``step`` (each rank
+    applies the subset it owns)."""
+    return [r for r in range(_DELTA_ROWS) if (r * 7 + step) % 3 == 0]
+
+
+def _delta_update(step: int, row: int) -> np.float32:
+    return np.float32(0.25 * ((step % 5) + 1) + 0.01 * row)
+
+
+def _delta_table_at(step: int) -> np.ndarray:
+    """Closed-form reference: the full table after ``step`` steps."""
+    t = np.zeros((_DELTA_ROWS, _DELTA_DIM), np.float32)
+    for s in range(step):
+        for r in _delta_touched_rows(s):
+            t[r] += _delta_update(s, r)
+    return t
+
+
 def run_checkpoint_drill(mode: str, ranks: int = 4, seed: int = 0,
                          steps: int = 12, commit_every: int = 3,
                          victim: int = None, kill_step: int = None,
                          ckpt_dir: str = None,
-                         commit_timeout_s: float = 3.0) -> dict:
+                         commit_timeout_s: float = 3.0,
+                         chain_max: int = 2) -> dict:
     """Kill-and-resume: ``ranks`` thread-ranks train a deterministic
     param vector, durably checkpointing every ``commit_every`` steps
     through the real two-phase pipeline (horovod_tpu.checkpoint); a
     seeded schedule kills one rank either ``mid_epoch`` (between
     checkpoints) or ``mid_write`` (inside its shard write, via the
-    ``ckpt.shard_write`` failpoint); the 'job restart' then restores
+    ``ckpt.shard_write`` failpoint); ``mid_delta`` dispatches to
+    :func:`run_delta_chain_drill` (kill inside a DIFFERENTIAL save via
+    ``ckpt.delta_write``).  The 'job restart' then restores
     from the last coordinator-committed checkpoint and the drill
     asserts
 
@@ -878,6 +906,12 @@ def run_checkpoint_drill(mode: str, ranks: int = 4, seed: int = 0,
                                         LocalCommitCoordinator)
     from horovod_tpu.checkpoint import manifest as _mf
 
+    if mode == "mid_delta":
+        return run_delta_chain_drill(
+            ranks=ranks, seed=seed, steps=steps,
+            commit_every=commit_every, chain_max=chain_max,
+            victim=victim, ckpt_dir=ckpt_dir,
+            commit_timeout_s=commit_timeout_s)
     assert mode in ("mid_epoch", "mid_write"), mode
     t0 = time.monotonic()
     rng = random.Random("%d|ckpt-drill|%s" % (seed, mode))
@@ -1021,6 +1055,238 @@ def run_checkpoint_drill(mode: str, ranks: int = 4, seed: int = 0,
         restore_mgr.close(timeout=1.0)
         if owned_dir:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
+    record["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return record
+
+
+def run_delta_chain_drill(ranks: int = 4, seed: int = 0,
+                          steps: int = 12, commit_every: int = 3,
+                          chain_max: int = 2,
+                          victim: int = None,
+                          ckpt_dir: str = None,
+                          commit_timeout_s: float = 3.0) -> dict:
+    """The differential-checkpoint cell of the kill-and-resume drill
+    (``run_checkpoint_drill(mode="mid_delta")``): thread-ranks train a
+    dense param vector PLUS a row-sharded sparse table, checkpointing
+    through the real two-phase pipeline with a periodic full base and
+    touched-rows-only :class:`RowDelta` links in between
+    (``HOROVOD_CKPT_DELTA_CHAIN_MAX``); a seeded schedule crashes one
+    rank INSIDE a delta save via the ``ckpt.delta_write`` failpoint.
+    The 'restart' then asserts
+
+    * the restored step is the last coordinator-committed one (the
+      killed delta never became visible),
+    * the assembled table is BIT-identical to the closed-form
+      reference at that step — i.e. base + the committed deltas
+      replay to exactly the full-checkpoint state, never a torn or
+      partially-applied chain,
+    * every committed step on disk (base or delta) still fully
+      verifies, and
+    * the committed tip really was a delta (the cell exercises the
+      chain, not a degenerate all-base run).
+    """
+    import shutil
+    import tempfile
+
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        LocalCommitCoordinator,
+                                        RowDelta, assemble_table)
+    from horovod_tpu.checkpoint import manifest as _mf
+
+    t0 = time.monotonic()
+    rng = random.Random("%d|delta-drill" % seed)
+    if victim is None:
+        victim = rng.randrange(1, ranks)
+    assert steps - 1 >= 2 * commit_every, (steps, commit_every)
+    # Commit boundaries are steps commit_every, 2*commit_every, ...;
+    # commit index i is a BASE when i % (chain_max + 1) == 0, a delta
+    # otherwise — a deterministic cadence every rank derives from its
+    # own commit count, so no rank ever disagrees on delta_of.
+    boundaries = list(range(commit_every, steps + 1, commit_every))
+    is_base = [i % (chain_max + 1) == 0
+               for i in range(len(boundaries))]
+    delta_idxes = [i for i, b in enumerate(is_base)
+                   if not b and boundaries[i] > 2 * commit_every]
+    if not delta_idxes:
+        # Always at least one eligible delta commit by construction
+        # (guard for exotic parameter choices).
+        delta_idxes = [i for i, b in enumerate(is_base) if not b][-1:]
+    kill_idx = rng.choice(delta_idxes)
+    kill_commit = boundaries[kill_idx]
+    # after= skips the victim's earlier healthy delta saves.
+    prior_deltas = sum(1 for i in range(kill_idx) if not is_base[i])
+    failpoints.configure(
+        "ckpt.delta_write=crash(times=1,rank=%d,after=%d)"
+        % (victim, prior_deltas), seed=seed)
+
+    def crash_handler(site):
+        raise SimCrash("injected crash at %s" % site)
+
+    failpoints.set_crash_handler(crash_handler)
+    owned_dir = ckpt_dir is None
+    if owned_dir:
+        ckpt_dir = tempfile.mkdtemp(prefix="hvd-delta-drill-")
+    old_env = os.environ.get("HOROVOD_CKPT_DELTA_CHAIN_MAX")
+    os.environ["HOROVOD_CKPT_DELTA_CHAIN_MAX"] = str(chain_max)
+    shape = (257,)
+
+    coord = LocalCommitCoordinator()
+    mgrs = [CheckpointManager(ckpt_dir, rank=r, world_size=ranks,
+                              coordinator=coord, keep=3,
+                              commit_timeout_s=commit_timeout_s)
+            for r in range(ranks)]
+    errors = []
+
+    def rank_loop(rank: int):
+        params = np.zeros(shape, np.float32)
+        table = np.zeros((_DELTA_ROWS, _DELTA_DIM), np.float32)
+        own = [r for r in range(_DELTA_ROWS) if r % ranks == rank]
+        touched = {}        # global row -> last-touched step
+        commit_idx = 0
+        last_saved = None   # (step_id, last step the capture covered)
+        try:
+            for step in range(steps):
+                params = params + _drill_grad(rank, step, shape)
+                for r in _delta_touched_rows(step):
+                    if r % ranks == rank:
+                        table[r] += _delta_update(step, r)
+                        touched[r] = step
+                if (step + 1) % commit_every == 0:
+                    # Bounded staleness + determinism: previous save
+                    # must be durable before the next one starts, and
+                    # — pre-kill — COMMITTED before this rank decides
+                    # its delta parent (all ranks then agree).
+                    mgrs[rank].wait(2 * commit_timeout_s + 10)
+                    if last_saved is not None:
+                        prev_step, prev_cover = last_saved
+                        deadline = time.monotonic() \
+                            + commit_timeout_s
+                        while coord.committed_step() != prev_step \
+                                and time.monotonic() < deadline:
+                            time.sleep(0.005)
+                        if coord.committed_step() == prev_step:
+                            # The committed delta covered touches up
+                            # to prev_cover; a row RE-touched since
+                            # then must stay marked or the next delta
+                            # silently drops it (the mask-vs-
+                            # generation hazard the engine also
+                            # guards against).
+                            for r in [r for r, s in touched.items()
+                                      if s <= prev_cover]:
+                                del touched[r]
+                    full = is_base[commit_idx]
+                    delta_of = None if full else coord.committed_step()
+                    if not full and delta_of is None:
+                        full = True  # no committed parent: force base
+                    rows = sorted(own if full else touched)
+                    items = {"obj/step": step + 1,
+                             "tree/params": params.copy()}
+                    local = {"%s.r%05d" % (_DELTA_PREFIX, rank):
+                             RowDelta(np.array(rows, np.int64),
+                                      table[rows].copy(),
+                                      _DELTA_ROWS)}
+                    is_kill = (rank == victim
+                               and step + 1 == kill_commit)
+                    mgrs[rank].save_async(step + 1, items,
+                                          local_items=local,
+                                          delta_of=delta_of)
+                    last_saved = (step + 1, step)
+                    commit_idx += 1
+                    if is_kill:
+                        # The injected crash fires inside THIS delta
+                        # save; drain to make the death ordering
+                        # deterministic, then die.
+                        mgrs[rank].wait(2 * commit_timeout_s + 10)
+                        raise SimCrash("mid-delta kill at commit %d"
+                                       % (step + 1))
+        except SimCrash:
+            mgrs[rank].abort()
+            return
+        except Exception as e:  # pragma: no cover - drill plumbing
+            errors.append("rank %d: %r" % (rank, e))
+
+    threads = [threading.Thread(target=rank_loop, args=(r,),
+                                name="delta-drill-r%d" % r,
+                                daemon=True)
+               for r in range(ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        if t.is_alive():
+            errors.append("%s never exited" % t.name)
+    for m in mgrs:
+        m.wait(timeout=2 * commit_timeout_s + 5)
+        m.close(timeout=1.0)
+    triggers = failpoints.snapshot()
+    failpoints.reset()
+    failpoints.set_crash_handler(None)
+
+    committed_before = coord.committed_step()
+    restore_mgr = CheckpointManager(ckpt_dir, rank=0, world_size=1)
+    record = {
+        "kind": "checkpoint_drill", "mode": "mid_delta",
+        "ranks": ranks, "seed": seed, "victim": victim,
+        "kill_commit": kill_commit, "steps": steps,
+        "commit_every": commit_every, "chain_max": chain_max,
+        "errors": errors, "failpoint_triggers": triggers,
+    }
+    try:
+        restored_step, items = restore_mgr.restore_latest()
+        chain = restore_mgr.chain_of(restored_step)
+        restored_params = items["tree/params"]
+        restored_table = assemble_table(items, _DELTA_PREFIX)
+        exp_params = _drill_params_at(restored_step, shape)
+        exp_table = _delta_table_at(restored_step)
+        bit_identical = (
+            bool(np.array_equal(restored_params, exp_params))
+            and bool(np.array_equal(restored_table, exp_table))
+            and restored_table.dtype == exp_table.dtype)
+        torn = []
+        deltas_on_disk = 0
+        for s in _mf.committed_steps(ckpt_dir):
+            try:
+                restore_mgr.restore(s)
+                if (_mf.read_manifest(_mf.step_dir(ckpt_dir, s))
+                        .meta or {}).get("delta_of") is not None:
+                    deltas_on_disk += 1
+            except Exception as e:
+                torn.append({"step": s, "error": repr(e)[:200]})
+        step_loss = kill_commit - restored_step
+        # The restore tip is a delta iff the commit before the killed
+        # one was one — when the kill lands on the first delta after
+        # a base, restoring that base IS correct, so the expectation
+        # is schedule-derived, not unconditional.
+        expect_tip_delta = kill_idx >= 1 and not is_base[kill_idx - 1]
+        record.update({
+            "committed_before_kill": committed_before,
+            "died_at_step": kill_commit,
+            "restored_step": restored_step,
+            "restored_chain": chain,
+            "tip_is_delta": len(chain) > 1,
+            "expect_tip_delta": expect_tip_delta,
+            "committed_deltas_on_disk": deltas_on_disk,
+            "bit_identical": bit_identical,
+            "step_loss": step_loss,
+            "step_loss_bound": 2 * commit_every,
+            "torn_checkpoints": torn,
+            "ok": (bit_identical and not torn and not errors
+                   and (len(chain) > 1) == expect_tip_delta
+                   and deltas_on_disk > 0
+                   and step_loss <= 2 * commit_every
+                   and (committed_before is None
+                        or restored_step >= committed_before)),
+        })
+    except Exception as e:
+        record.update({"ok": False, "error": repr(e)[:300]})
+    finally:
+        restore_mgr.close(timeout=1.0)
+        if owned_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        if old_env is None:
+            os.environ.pop("HOROVOD_CKPT_DELTA_CHAIN_MAX", None)
+        else:
+            os.environ["HOROVOD_CKPT_DELTA_CHAIN_MAX"] = old_env
     record["elapsed_s"] = round(time.monotonic() - t0, 3)
     return record
 
@@ -1424,9 +1690,9 @@ def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
              checkpoint_drill: bool = True) -> dict:
     """Run ``schedules`` seeded schedules; returns the full artifact
     dict.  ``ok`` is True iff no schedule hung, mis-reduced, or failed
-    to recover — and, with ``checkpoint_drill``, iff both
-    kill-and-resume drills restored bit-identical params from the last
-    committed checkpoint."""
+    to recover — and, with ``checkpoint_drill``, iff every
+    kill-and-resume drill (mid-epoch, mid-shard-write, mid-delta-write)
+    restored bit-identical state from the last committed checkpoint."""
     t0 = time.monotonic()
     records = []
     for i in range(schedules):
@@ -1446,7 +1712,7 @@ def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
            if r["outcome"] in ("hang", "incorrect", "recovery_failed")]
     drills = []
     if checkpoint_drill:
-        for mode in ("mid_epoch", "mid_write"):
+        for mode in ("mid_epoch", "mid_write", "mid_delta"):
             logger.info("checkpoint drill: %s", mode)
             drills.append(run_checkpoint_drill(mode, ranks=min(ranks, 4),
                                                seed=seed))
