@@ -1237,6 +1237,100 @@ def bench_scale(args, smoke: bool) -> dict:
     return data
 
 
+def bench_coord_scale(args, smoke: bool) -> dict:
+    """Relay-tree negotiation-latency lane at {8, 64, 256} simulated
+    ranks (tools/chaos_soak.run_scale_lane): protocol-only clients
+    drive full negotiation rounds through real relays vs the flat
+    star.  The artifact records per-size wall latency, the root's
+    serialized fan-out cost (the quantity HOROVOD_COORD_FANOUT bounds
+    to O(fanout) — and the honest sub-linearity witness on this
+    shared-core rig, where in-process relays cannot parallelize), and
+    the deterministic root-sends-per-round counts.  Plus one 64-rank
+    relay kill-mid-negotiation drill so the robustness claim rides
+    the same artifact."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from chaos_soak import run_relay_drill, run_scale_lane
+
+    sizes = tuple(int(s) for s in os.environ.get(
+        "HOROVOD_BENCH_COORD_SIZES", "8,64,256").split(","))
+    fanout = int(os.environ.get("HOROVOD_BENCH_COORD_FANOUT", "8"))
+    out = run_scale_lane(sizes=sizes, fanout=fanout,
+                         rounds=4 if smoke else 8)
+    try:
+        drill = run_relay_drill(fault="kill", when="negotiation",
+                                ranks=64 if not smoke else 16,
+                                fanout=fanout, seed=0)
+        out["relay_kill_drill"] = {
+            k: drill.get(k) for k in
+            ("ranks", "fanout", "rehomed", "rehome_s",
+             "rehome_bound_s", "ok")}
+    except Exception as e:
+        out["relay_kill_drill"] = {"error": repr(e)[:300]}
+    return out
+
+
+def check_coord_scale_regression(out: dict, repo_dir: str):
+    """The scale lane is regression-gated like the smoke headline:
+    warn when latency-vs-ranks growth goes super-linear, when the
+    relay drill fails, or when the root fan-out cost regressed beyond
+    the noise band vs the prior round's artifact."""
+    import glob
+    import re
+    cur = out.get("coord_scale") or {}
+    if not cur or "error" in cur:
+        return
+    if cur.get("sublinear") is False:
+        print("WARNING: coordinator negotiation latency grew "
+              "SUPER-linearly with world size (root broadcast growth "
+              "%.1fx over %.0fx ranks) — the relay tree is not "
+              "bounding rank-0 fan-out"
+              % (cur.get("root_broadcast_growth") or -1,
+                 cur.get("rank_growth") or -1), file=sys.stderr)
+    drill = cur.get("relay_kill_drill") or {}
+    if drill and not drill.get("ok"):
+        print("WARNING: the 64-rank relay kill drill failed — "
+              "interior fan-out loss is not being survived",
+              file=sys.stderr)
+    prior = None
+    for path in reversed(sorted(glob.glob(
+            os.path.join(repo_dir, "BENCH_r*.json")))):
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        m = re.search(
+            r'"coord_scale\\?":.*?"root_broadcast_growth\\?":\s*'
+            r'(-?[0-9.]+)', raw, re.S)
+        if m:
+            prior = {"root_broadcast_growth": float(m.group(1)),
+                     "source": os.path.basename(path)}
+            break
+    if prior is None:
+        return  # first round with a coord-scale lane
+    cur_g = cur.get("root_broadcast_growth")
+    if cur_g is None:
+        return
+    tol_pct = 50.0  # wall-clock micro-measurement on a shared core
+    delta_pct = (cur_g - prior["root_broadcast_growth"]) \
+        / max(prior["root_broadcast_growth"], 1e-9) * 100.0
+    cur["coord_scale_vs_prior"] = {
+        "prior_root_broadcast_growth":
+            prior["root_broadcast_growth"],
+        "prior_source": prior["source"],
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": tol_pct,
+        "regressed": delta_pct > tol_pct,
+    }
+    if cur["coord_scale_vs_prior"]["regressed"]:
+        print("WARNING: coordinator scale growth regressed %.1f%% vs "
+              "%s (%.1fx -> %.1fx), beyond the %.0f%% band"
+              % (delta_pct, prior["source"],
+                 prior["root_broadcast_growth"], cur_g, tol_pct),
+              file=sys.stderr)
+
+
 def bench_dlrm(args, smoke: bool) -> dict:
     """The recsys/DLRM-tiny lane at 8 CPU worker ranks (ROADMAP open
     item 5): model-parallel sharded embedding tables exchanged through
@@ -1636,7 +1730,7 @@ def main():
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
                         "collectives", "checkpoint", "scale",
-                        "recovery", "dlrm"],
+                        "recovery", "dlrm", "coordscale"],
                    default=None)
     args = p.parse_args()
 
@@ -1690,7 +1784,8 @@ def main():
 
     run = {args.only} if args.only else {"resnet", "bert", "keras",
                                      "collectives", "checkpoint",
-                                     "scale", "recovery", "dlrm"}
+                                     "scale", "recovery", "dlrm",
+                                     "coordscale"}
 
     resnet = {}
     if "resnet" in run:
@@ -1759,6 +1854,13 @@ def main():
         except Exception as e:
             out["dlrm_tiny"] = {"error": repr(e)[:300]}
         check_dlrm_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "coordscale" in run:
+        try:
+            out["coord_scale"] = bench_coord_scale(args, args.smoke)
+        except Exception as e:
+            out["coord_scale"] = {"error": repr(e)[:300]}
+        check_coord_scale_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:

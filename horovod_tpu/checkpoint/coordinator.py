@@ -151,11 +151,39 @@ class KVCommitCoordinator(CommitCoordinator):
         marks: Dict[int, dict] = {}
         consecutive_errors = 0
         warned = False
+        prefix = "prepare-%d-" % step
         while True:
             poll_errored = False
-            for rank in range(world_size):
-                if rank in marks:
-                    continue
+            # One scope listing bounds each poll at O(1) requests:
+            # only marks that actually LANDED are fetched (at most
+            # world_size fetches over the whole gather), instead of
+            # world_size GETs per tick — the arbiter's poll no longer
+            # scales with the world (the same O(world)-per-interval
+            # fix as the coordinator's deadline-heap liveness sweep).
+            lister = getattr(self._client, "keys", None)
+            if lister is not None:
+                try:
+                    present = [k for k in lister(SCOPE)
+                               if k.startswith(prefix)]
+                except OSError:
+                    present = None
+            else:
+                present = None
+            if present is not None:
+                pending = []
+                for k in present:
+                    try:
+                        r = int(k[len(prefix):])
+                    except ValueError:
+                        continue
+                    if 0 <= r < world_size and r not in marks:
+                        pending.append(r)
+            else:
+                pending = [r for r in range(world_size)
+                           if r not in marks]
+                if lister is not None:
+                    poll_errored = True
+            for rank in pending:
                 try:
                     raw = self._client.get(SCOPE,
                                            self._prep_key(step, rank))

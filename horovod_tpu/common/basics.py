@@ -492,7 +492,11 @@ def cluster_metrics_snapshot():
     """Merged cross-rank snapshot, available on the rank that hosts the
     Python coordinator once HOROVOD_METRICS_AGG_SECONDS-driven polls
     have collected per-rank snapshots; None anywhere else (workers,
-    native coordinator, aggregation disabled)."""
+    native coordinator, aggregation disabled).  With a relay tree
+    armed (HOROVOD_COORD_FANOUT>0) the merge is O(fanout) at the root:
+    relays pre-aggregate their subtree's replies into one MA frame
+    each, and the returned ``ranks`` list still names every leaf
+    contributor."""
     state = _state()
     server = getattr(getattr(state.runtime, "controller", None),
                      "server", None)

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from . import env as env_mod
 from . import failpoints as _fp
 from . import metrics
+from . import relay as relay_mod
 from .controller import Controller, MessageTable, construct_response
 from .fusion import fuse_responses
 from .message import (Request, RequestType, Response, ResponseType,
@@ -69,6 +70,33 @@ _MAGIC_WELCOME = b"WE"  # coord→worker: reconnect handshake answer
 # no wire-format change).  A resume point older than the buffer is
 # unrecoverable and promotes the rank to lost.
 _LINK_LOG_FRAMES = 512
+
+# Out-of-stream frames: pure signals (HB liveness) and absolute
+# snapshots (MQ polls / MR replies) are excluded from the replay
+# rings and the stream cursors on BOTH sides — replaying them buys
+# nothing, and excluding them is what lets a relay consume a child's
+# HBs (one relay HB stands in for the subtree) and aggregate its MR
+# replies into one MA frame without desyncing the resume arithmetic.
+# Frame bytes on the wire are unchanged; only the cursor accounting
+# moved, symmetrically, on both endpoints.
+_OOS_DOWN = (_MAGIC_HB, _MAGIC_METRICS_REQ)
+_OOS_UP = (_MAGIC_HB, _MAGIC_METRICS_REP)
+
+
+class _LinkToken:
+    """Mux registration for one root link in tree mode: a direct leaf
+    (kind="leaf", ident=rank, gen=conn generation) or a relay link
+    (kind="relay", ident=relay id, gen=relay generation)."""
+    __slots__ = ("kind", "ident", "gen", "clean")
+
+    def __init__(self, kind, ident, gen):
+        self.kind = kind
+        self.ident = ident
+        self.gen = gen
+        self.clean = False
+
+    def __repr__(self):
+        return "<link %s %s g%d>" % (self.kind, self.ident, self.gen)
 
 _FRAMES_SENT = metrics.counter(
     "hvd_frames_sent_total", "Control-plane frames sent, by kind")
@@ -110,29 +138,11 @@ _RECONNECTS = metrics.counter(
     "could not replay; expired = coordinator grace window ran out)")
 
 
-def _send_frame(sock: socket.socket, magic: bytes, payload: bytes):
-    sock.sendall(magic + struct.pack("<I", len(payload)) + payload)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
-
-
-def _recv_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes]]:
-    head = _recv_exact(sock, 6)
-    if head is None:
-        return None
-    magic, ln = head[:2], struct.unpack("<I", head[2:])[0]
-    payload = _recv_exact(sock, ln)
-    if payload is None:
-        return None
-    return magic, payload
+# The wire-framing primitives live ONCE, in relay.py (this module
+# imports relay; the reverse would be a cycle).  The old private names
+# stay as aliases — tests and tools import them from here.
+_send_frame = relay_mod.send_frame
+_recv_frame = relay_mod.recv_frame
 
 
 class _LinkSilent(Exception):
@@ -179,7 +189,11 @@ def _parse_registration(payload: bytes) -> Tuple[int, dict]:
     """Registration frame payload: 4-byte rank, optionally followed by
     a JSON session blob (reconnecting-channel handshake).  The plain
     4-byte form remains valid — and is all the native coordinator ever
-    sees (it reads the first 4 bytes and ignores the rest)."""
+    sees (it reads the first 4 bytes and ignores the rest).  A
+    too-short payload (garbage client) parses as an invalid rank
+    rather than raising into the accept loop."""
+    if len(payload) < 4:
+        return -1, {}
     rank = struct.unpack("<i", payload[:4])[0]
     session = {}
     if len(payload) > 4:
@@ -207,6 +221,7 @@ class CoordinatorServer:
                  liveness_timeout_s: float = 0.0,
                  reconnect_grace_s: float = 0.0,
                  registration_timeout_s: float = 30.0,
+                 fanout: int = 0,
                  on_rank_lost=None):
         self.size = size
         self.fusion_threshold = fusion_threshold
@@ -295,6 +310,42 @@ class CoordinatorServer:
         self._out_seq: Dict[int, int] = {}    # downlink frames sent
         self._in_count: Dict[int, int] = {}   # uplink frames processed
         self._last_broadcast_t = time.monotonic()
+        # --- relay-tree fan-out (common/relay.py, HOROVOD_COORD_FANOUT)
+        # Per-rank stream state above stays HERE even for ranks served
+        # through a relay: relays are stateless forwarders, so every
+        # re-home resumes against the root's out-logs and cursors.
+        self._plan = relay_mod.plan_tree(size, fanout) \
+            if fanout > 0 else None
+        self._tree = self._plan is not None
+        self._rank_via: Dict[int, int] = {}    # rank -> root-side relay
+        self._via_epoch: Dict[int, int] = {}   # rank -> child-conn epoch
+        self._via_suspect: Dict[int, tuple] = {}  # rank -> (t, gen)
+        self._relay_conns: Dict[int, socket.socket] = {}
+        self._relay_gen: Dict[int, int] = {}
+        self._relay_depth: Dict[int, int] = {}
+        self._relay_metrics: Dict[int, dict] = {}
+        # Lazy deadline heap: the liveness sweep visits only links
+        # whose deadline lapsed, O(due) per tick instead of O(world)
+        # (relay.DeadlineHeap; pinned by tests/test_relay_tree.py).
+        self._lheap = relay_mod.DeadlineHeap()
+        # Plain-int probe counters (tools/chaos_soak scale probe reads
+        # them; ints, not registry metrics, so the hot path pays only
+        # the increments).
+        self.uplink_frames = 0
+        self.bcast_ns = 0
+        self.bcast_sends = 0
+        self._mux = None
+        if self._tree:
+            # Selector/batched recv loop: ONE thread drains every root
+            # link (O(fanout) relay links + direct leaves) instead of
+            # a thread per rank.  Flat star (fanout=0) keeps the
+            # thread-per-link path byte-identically.
+            self._mux = relay_mod.FrameMux(
+                self._mux_frame, self._mux_close,
+                name="hvd-coord-mux", on_data=self._mux_data)
+            self._mux.start()
+            logger.info("relay-tree coordinator: %s",
+                        self._plan.to_meta())
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -369,10 +420,57 @@ class CoordinatorServer:
                 conn.close()
                 continue
             rank, sess = _parse_registration(frame[1])
-            if sess.get("resume"):
+            if relay_mod.is_relay_reg(rank):
+                self._register_relay(
+                    relay_mod.relay_id_from_reg(rank), sess, conn)
+            elif rank < 0 or rank >= self.size:
+                logger.warning("refusing registration with invalid "
+                               "rank %d", rank)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            elif sess.get("resume"):
                 self._try_resume(rank, sess, conn)
             else:
                 self._register_fresh(rank, sess, conn)
+
+    def _register_relay(self, rid: int, sess: dict,
+                        conn: socket.socket):
+        """A relay link attached (tree mode): it serves every leaf
+        whose RG registration it forwards; it carries no stream state
+        of its own (stateless fail-stop forwarder)."""
+        if not self._tree:
+            logger.warning("refusing relay %d registration: "
+                           "HOROVOD_COORD_FANOUT is off", rid)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            old = self._relay_conns.get(rid)
+            self._relay_conns[rid] = conn
+            self._relay_gen[rid] = gen = self._relay_gen.get(rid, 0) + 1
+            self._relay_depth[rid] = max(1, int(sess.get(
+                "depth_below", 1)))
+            key = ("relay", rid)
+            self._last_heard[key] = time.monotonic()
+            if self.liveness_interval_s > 0:
+                self._lheap.schedule(
+                    key, self._last_heard[key] +
+                    env_mod.depth_aware_liveness_timeout(
+                        self.liveness_timeout_s,
+                        self._relay_depth[rid]))
+        if old is not None and old is not conn:
+            try:
+                old.close()
+            except OSError:
+                pass
+        conn.settimeout(None)
+        logger.info("relay %d link registered (depth_below=%d)", rid,
+                    self._relay_depth[rid])
+        self._mux.add(_LinkToken("relay", rid, gen), conn)
 
     def _install_conn_locked(self, rank: int, conn: socket.socket) -> int:
         """Install ``conn`` as rank's live link (superseding any stale
@@ -386,10 +484,21 @@ class CoordinatorServer:
             except OSError:
                 pass
         self._conns[rank] = conn
+        # A direct link supersedes any relay attachment (re-home to
+        # the root after a relay loss).
+        self._rank_via.pop(rank, None)
+        self._via_epoch.pop(rank, None)
+        self._via_suspect.pop(rank, None)
         self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
         self._stream_locks.setdefault(rank, threading.Lock())
         self._last_heard[rank] = time.monotonic()
         if self.liveness_interval_s > 0:
+            self._lheap.schedule(rank, self._last_heard[rank] +
+                                 self.liveness_timeout_s)
+        if self._tree:
+            # Mux-served link: select() gates recv, no poll timeout.
+            conn.settimeout(None)
+        elif self.liveness_interval_s > 0:
             # Bounded registered-link recv: the rank loop polls at a
             # fraction of the liveness timeout instead of blocking in
             # recv forever (the pre-liveness settimeout(None) hole).
@@ -416,11 +525,26 @@ class CoordinatorServer:
             if self._synced_params is not None:
                 self._send_to_rank_locked(rank, _MAGIC_PARAMS,
                                           self._synced_params)
-            if not self._formed and len(self._conns) >= self.size:
-                self._formed = True
-                pre, self._pre_formed = self._pre_formed, []
-                for kind, r, payload in pre:
-                    self._dispatch_uplink_locked(kind, r, payload)
+            self._maybe_form_locked()
+        self._note_fresh_life(rank)
+        self._serve_link(rank, conn, gen)
+
+    def _attached_ranks_locked(self) -> Set[int]:
+        """Leaf ranks currently attached — directly or via a relay
+        (caller holds self._lock)."""
+        ranks = set(self._conns.keys())
+        ranks.update(self._rank_via.keys())
+        return ranks
+
+    def _maybe_form_locked(self):
+        if not self._formed and \
+                len(self._attached_ranks_locked()) >= self.size:
+            self._formed = True
+            pre, self._pre_formed = self._pre_formed, []
+            for kind, r, payload in pre:
+                self._dispatch_uplink_locked(kind, r, payload)
+
+    def _note_fresh_life(self, rank: int):
         with self._departed_cond:
             # A fresh session is a new rank life: it gets its own
             # seen/departed pair (a restarted process re-registering
@@ -428,7 +552,501 @@ class CoordinatorServer:
             self._departure_counted.discard(rank)
             self._seen += 1
             self._departed_cond.notify_all()
-        self._spawn_rank_loop(rank, conn, gen)
+
+    def _serve_link(self, rank: int, conn: socket.socket, gen: int):
+        if self._tree:
+            self._mux.add(_LinkToken("leaf", rank, gen), conn)
+        else:
+            self._spawn_rank_loop(rank, conn, gen)
+
+    # ------------------------------------------------------------------
+    # tree mode: the selector/batched recv loop (one thread, all links)
+    # ------------------------------------------------------------------
+    def _mux_data(self, token: "_LinkToken"):
+        # Chunk-level liveness refresh: a large frame trickling in
+        # slower than the deadline still counts as a live peer (the
+        # thread-mode on_data analog).
+        key = token.ident if token.kind == "leaf" \
+            else ("relay", token.ident)
+        self._last_heard[key] = time.monotonic()
+
+    def _mux_frame(self, token: "_LinkToken", magic: bytes,
+                   payload: bytes):
+        if self._stop.is_set():
+            return False
+        if token.kind == "relay":
+            return self._relay_frame(token, magic, payload)
+        return self._direct_frame(token, magic, payload)
+
+    def _direct_frame(self, token: "_LinkToken", magic: bytes,
+                      payload: bytes):
+        """One frame from a DIRECT leaf link in tree mode — the exact
+        semantics of the flat-star rank loop body."""
+        rank, gen = token.ident, token.gen
+        if self._conn_gen.get(rank, 0) != gen:
+            return False  # superseded; on_close is a no-op via gen
+        self._last_heard[rank] = time.monotonic()
+        if magic in _OOS_UP:
+            _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
+            if magic == _MAGIC_METRICS_REP:
+                self._handle_metrics_snapshot(rank, payload)
+            return True
+        self.uplink_frames += 1
+        if _fp.ENABLED:
+            try:
+                if _fp.maybe_fail("coord.frame_recv",
+                                  rank=rank) == "drop":
+                    lock = self._stream_locks.get(rank)
+                    if lock is not None:
+                        with lock:
+                            if self._conn_gen.get(rank, 0) == gen:
+                                self._in_count[rank] = \
+                                    self._in_count.get(rank, 0) + 1
+                    return True
+            except _fp.FailpointError:
+                return False  # injected error kills this link
+        _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
+        _BYTES_RECV.inc(len(payload) + 6)
+        stream_lock = self._stream_locks.get(rank)
+        if stream_lock is None:
+            return False
+        with stream_lock:
+            if self._conn_gen.get(rank, 0) != gen:
+                return False
+            try:
+                if magic == _MAGIC_HITS:
+                    self._handle_cache_hits(rank, unpack_bits(payload))
+                    return True
+                requests, shutdown = unpack_request_list(payload)
+                if shutdown:
+                    token.clean = True
+                    return False
+                self._handle_requests(rank, requests)
+                return True
+            finally:
+                self._in_count[rank] = self._in_count.get(rank, 0) + 1
+
+    def _relay_frame(self, token: "_LinkToken", magic: bytes,
+                     payload: bytes):
+        rid, gen = token.ident, token.gen
+        if self._relay_gen.get(rid, 0) != gen:
+            return False
+        self._last_heard[("relay", rid)] = time.monotonic()
+        if magic == _MAGIC_HB:
+            _FRAMES_RECV.inc(1, kind="HB")
+            return True
+        if magic == relay_mod.MAGIC_METRICS_AGG:
+            self._handle_metrics_aggregate(rid, payload)
+            return True
+        if magic == relay_mod.MAGIC_RELAY_LOST:
+            self._handle_relay_lost(rid, payload)
+            return True
+        if magic == relay_mod.MAGIC_RELAY_BATCH:
+            self.uplink_frames += 1
+            _FRAMES_RECV.inc(1, kind="RB")
+            _BYTES_RECV.inc(len(payload) + 6)
+            try:
+                items = relay_mod.unpack_rb_items(payload)
+            except (struct.error, IndexError):
+                logger.error("corrupt RB frame from relay %d; "
+                             "dropping the link", rid)
+                return False
+            for origin, epoch, imagic, ipayload in items:
+                self._relay_item(rid, origin, epoch, imagic, ipayload)
+            return True
+        logger.warning("unexpected %s frame on relay link %d",
+                       magic.decode("ascii", "replace"), rid)
+        return True
+
+    def _relay_item(self, rid: int, origin: int, epoch: int,
+                    magic: bytes, payload: bytes):
+        """One leaf uplink item forwarded through a relay.  Stream
+        items (CH/RQ) are processed under the leaf's stream lock with
+        an attachment check — (relay id, child epoch) must match the
+        rank's current attachment, so frames in flight from a
+        superseded child socket are discarded UN-counted and the
+        leaf's resume replay re-delivers them exactly once."""
+        if magic == relay_mod.MAGIC_REGISTER:
+            rank, sess = _parse_registration(payload)
+            if rank != origin:
+                logger.warning("relay %d forwarded a registration for "
+                               "rank %d tagged origin %d; ignoring",
+                               rid, rank, origin)
+                return
+            if sess.get("resume"):
+                self._try_resume_remote(rank, sess, rid, epoch)
+            else:
+                self._register_fresh_remote(rank, sess, rid, epoch)
+            return
+        if magic in _OOS_UP:
+            # Relays normally consume HB/MR; handle stragglers anyway.
+            if magic == _MAGIC_METRICS_REP:
+                self._handle_metrics_snapshot(origin, payload)
+            return
+        if _fp.ENABLED:
+            try:
+                if _fp.maybe_fail("coord.frame_recv",
+                                  rank=origin) == "drop":
+                    lock = self._stream_locks.get(origin)
+                    if lock is not None:
+                        with lock:
+                            if self._rank_via.get(origin) == rid and \
+                                    self._via_epoch.get(origin) == epoch:
+                                self._in_count[origin] = \
+                                    self._in_count.get(origin, 0) + 1
+                    return
+            except _fp.FailpointError:
+                logger.warning("failpoint coord.frame_recv: injected "
+                               "error on relayed frame; dropping it")
+                return
+        stream_lock = self._stream_locks.get(origin)
+        if stream_lock is None:
+            return  # never registered; nothing to do
+        with stream_lock:
+            if self._rank_via.get(origin) != rid or \
+                    self._via_epoch.get(origin) != epoch:
+                return  # superseded attachment; un-counted
+            try:
+                if magic == _MAGIC_HITS:
+                    self._handle_cache_hits(origin,
+                                            unpack_bits(payload))
+                    return
+                requests, shutdown = unpack_request_list(payload)
+                if shutdown:
+                    self._remote_clean_departure(origin)
+                    return
+                self._handle_requests(origin, requests)
+            finally:
+                self._in_count[origin] = \
+                    self._in_count.get(origin, 0) + 1
+
+    def _remote_clean_departure(self, rank: int):
+        """Shutdown frame from a relay-attached rank — the mirror of
+        the rank loop's clean exit (caller holds the stream lock; the
+        server lock nests inside it everywhere)."""
+        with self._lock:
+            self._detach_rank_locked(rank)
+        self._count_departed(rank)
+        if not self._stop.is_set():
+            self._promote_lost(rank, clean=True)
+
+    def _detach_rank_locked(self, rank: int):
+        old = self._conns.pop(rank, None)
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._rank_via.pop(rank, None)
+        self._via_epoch.pop(rank, None)
+        self._via_suspect.pop(rank, None)
+
+    def _register_fresh_remote(self, rank: int, sess: dict, rid: int,
+                               epoch: int):
+        """Fresh leaf registration forwarded through a relay: the
+        mirror of _register_fresh with the relay link as transport.
+        The targeted WE ack opens the relay's broadcast gate for this
+        child — broadcasts the root sent before this point were never
+        logged for the rank, so the relay must not deliver them."""
+        with self._lock:
+            if self._relay_conns.get(rid) is None:
+                return
+            self._detach_rank_locked(rank)
+            self._conn_gen[rank] = self._conn_gen.get(rank, 0) + 1
+            self._rank_via[rank] = rid
+            self._via_epoch[rank] = epoch
+            self._stream_locks.setdefault(rank, threading.Lock())
+            self._last_heard[rank] = time.monotonic()
+            self._sessions[rank] = sess.get("session", "")
+            self._limbo.pop(rank, None)
+            # Relay-attached ranks report metrics through their
+            # relay's MA aggregate; a frozen direct snapshot left
+            # behind would double count them in every future merge.
+            self._rank_metrics.pop(rank, None)
+            self._out_seq[rank] = 0
+            self._in_count[rank] = 0
+            if self.reconnect_grace_s > 0:
+                self._out_log[rank] = deque(maxlen=_LINK_LOG_FRAMES)
+            self._send_targeted_locked(
+                rank, _MAGIC_WELCOME,
+                json.dumps({"resume": False, "recv_count": 0}).encode(),
+                log=False)
+            if self._synced_params is not None:
+                self._send_targeted_locked(rank, _MAGIC_PARAMS,
+                                           self._synced_params)
+            self._maybe_form_locked()
+        self._note_fresh_life(rank)
+
+    def _try_resume_remote(self, rank: int, sess: dict, rid: int,
+                           epoch: int):
+        """Resume handshake arriving through a relay (a leaf
+        re-homing after its previous link — possibly a whole relay —
+        died).  Same three-phase structure as _try_resume; WE + the
+        downlink replay travel RD-wrapped so the relay routes them to
+        exactly this child (and opens its broadcast gate)."""
+        with self._lock:
+            recv_count = int(sess.get("recv_count", 0))
+            out_seq = self._out_seq.get(rank, 0)
+            log = self._out_log.get(rank)
+            rconn = self._relay_conns.get(rid)
+            ok = (self.reconnect_grace_s > 0 and
+                  rank not in self._lost and
+                  rconn is not None and
+                  sess.get("session") and
+                  sess.get("session") == self._sessions.get(rank) and
+                  log is not None and
+                  0 <= recv_count <= out_seq and
+                  out_seq - recv_count <= len(log))
+            if not ok:
+                logger.warning(
+                    "refusing relayed resume for rank %d via relay %d "
+                    "(session %s, recv_count %d/%d)", rank, rid,
+                    (sess.get("session") or "?")[:8], recv_count,
+                    out_seq)
+                _RECONNECTS.inc(1, outcome="refused")
+                if rconn is not None:
+                    try:
+                        _send_frame(rconn, relay_mod.MAGIC_RELAY_DOWN,
+                                    relay_mod.pack_rd(
+                                        rank, _MAGIC_WELCOME,
+                                        json.dumps({"resume": False}
+                                                   ).encode()))
+                    except OSError:
+                        pass
+                return
+            # Phase 1: supersede the old attachment (direct conn OR a
+            # previous relay/epoch); hold the rank in limbo so
+            # broadcasts keep logging until the backlog is replayed.
+            old = self._conns.pop(rank, None)
+            self._rank_via.pop(rank, None)
+            self._via_epoch.pop(rank, None)
+            self._via_suspect.pop(rank, None)
+            self._conn_gen[rank] = gen = \
+                self._conn_gen.get(rank, 0) + 1
+            self._limbo[rank] = time.monotonic()
+            stream_lock = self._stream_locks.setdefault(
+                rank, threading.Lock())
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        # Phase 2: wait out an in-flight frame on the old transport so
+        # the uplink cursor is stable before we quote it.
+        with stream_lock:
+            in_count = self._in_count.get(rank, 0)
+        # Phase 3: attach via the relay and replay the missed downlink.
+        with self._lock:
+            rconn = self._relay_conns.get(rid)
+            if self._conn_gen.get(rank, 0) != gen or \
+                    rank in self._lost or rconn is None or \
+                    self._out_seq.get(rank, 0) - recv_count > len(log):
+                logger.warning("relayed resume for rank %d aborted "
+                               "mid-handshake", rank)
+                _RECONNECTS.inc(1, outcome="refused")
+                return
+            self._rank_via[rank] = rid
+            self._via_epoch[rank] = epoch
+            self._last_heard[rank] = time.monotonic()
+            self._limbo.pop(rank, None)
+            # See _register_fresh_remote: metrics now ride the relay's
+            # MA aggregate; drop any frozen direct snapshot.
+            self._rank_metrics.pop(rank, None)
+            try:
+                _send_frame(rconn, relay_mod.MAGIC_RELAY_DOWN,
+                            relay_mod.pack_rd(
+                                rank, _MAGIC_WELCOME,
+                                json.dumps({"resume": True,
+                                            "recv_count": in_count}
+                                           ).encode()))
+                for ordinal, magic, payload in log:
+                    if ordinal > recv_count:
+                        _send_frame(rconn, relay_mod.MAGIC_RELAY_DOWN,
+                                    relay_mod.pack_rd(rank, magic,
+                                                      payload))
+            except OSError:
+                # The relay link died mid-handshake: back to limbo;
+                # the leaf retries (and will climb its ancestor chain).
+                self._rank_via.pop(rank, None)
+                self._via_epoch.pop(rank, None)
+                self._enter_limbo_locked(rank)
+                return
+        logger.info("rank %d re-homed via relay %d (replayed %d "
+                    "downlink frames)", rank, rid,
+                    self._out_seq.get(rank, 0) - recv_count)
+        _RECONNECTS.inc(1, outcome="resumed")
+
+    def _send_targeted_locked(self, rank: int, magic: bytes,
+                              payload: bytes, log: bool = True):
+        """One downlink frame to one specific rank, over whatever
+        transport it is attached by — direct send, or RD-wrapped via
+        its relay (caller holds self._lock)."""
+        if log:
+            self._log_out_locked(rank, magic, payload)
+        conn = self._conns.get(rank)
+        if conn is not None:
+            try:
+                _send_frame(conn, magic, payload)
+                return True
+            except OSError:
+                if self.reconnect_grace_s > 0 and \
+                        rank not in self._lost:
+                    self._enter_limbo_locked(rank)
+                else:
+                    self._conns.pop(rank, None)
+                return False
+        rid = self._rank_via.get(rank)
+        rconn = self._relay_conns.get(rid) if rid is not None else None
+        if rconn is None:
+            return False
+        try:
+            _send_frame(rconn, relay_mod.MAGIC_RELAY_DOWN,
+                        relay_mod.pack_rd(rank, magic, payload))
+            return True
+        except OSError:
+            return False  # the mux reaps the dead relay link
+
+    def _subtree_slack(self) -> float:
+        """Detection allowance for leaves behind a troubled interior
+        node: before they can re-home they must first notice the
+        silence themselves, bounded by their own depth-aware deadline
+        (they may be deeper than the link the root observed)."""
+        levels = self._plan.levels if self._plan is not None else 1
+        return env_mod.depth_aware_liveness_timeout(
+            self.liveness_timeout_s, levels)
+
+    def _relay_link_down(self, rid: int, gen: int,
+                         reason: Optional[str] = None):
+        """A relay link died (EOF at the mux, or the liveness sweep).
+        Its whole subtree enters limbo — the leaves behind it may be
+        perfectly healthy and re-home within the grace window; only
+        grace expiry promotes them (through the existing elastic
+        eviction path).  The limbo clock carries detection slack: a
+        WEDGED relay is seen by the root before its leaves can see
+        the silence themselves.  With reconnects off, the subtree is
+        promoted immediately (legacy fail-fast)."""
+        with self._lock:
+            if self._relay_gen.get(rid, 0) != gen:
+                return
+            self._relay_gen[rid] = gen + 1  # supersede in-flight frames
+            conn = self._relay_conns.pop(rid, None)
+            self._relay_metrics.pop(rid, None)
+            subtree = sorted(r for r, v in self._rank_via.items()
+                             if v == rid)
+            stopped = self._stop.is_set()
+            limbo = not stopped and self.reconnect_grace_s > 0
+            slack = self._subtree_slack()
+            for r in subtree:
+                self._rank_via.pop(r, None)
+                self._via_epoch.pop(r, None)
+                if limbo and r not in self._lost:
+                    self._enter_limbo_locked(r)
+                    self._limbo[r] = time.monotonic() + slack
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if stopped:
+            return
+        if subtree:
+            logger.warning(
+                "relay %d link down (%s): %s", rid,
+                reason or "connection lost",
+                ("holding %d ranks in limbo for %.1fs grace"
+                 % (len(subtree), self.reconnect_grace_s)) if limbo
+                else "promoting %d ranks to lost" % len(subtree))
+        if limbo:
+            return
+        for r in subtree:
+            self._count_departed(r)
+            self._promote_lost(r, clean=False,
+                               reason=reason or "relay link lost")
+
+    def _handle_relay_lost(self, rid: int, payload: bytes):
+        """RL notice: a relay reports children lost.  kind="silent"
+        means the child ITSELF went quiet past the per-hop deadline
+        (the wedged-rank case — promote, like the root's own liveness
+        on direct links); kind="disconnect" is a dead child socket —
+        grace window first, the leaf may simply re-home.  Entries
+        carry the child-connection epoch when the reporter was the
+        leaf's direct parent; epoch-less entries mean the trouble was
+        INTERIOR (a sub-relay under the reporter died or went silent —
+        the leaves behind it may be perfectly healthy and will
+        self-detect), so they only arm a suspicion clock with
+        detection slack: a leaf whose re-home already raced ahead is
+        never yanked back, and one that resumes within slack + grace
+        is never promoted at all."""
+        try:
+            notice = json.loads(payload.decode())
+            entries = [(int(r), None if e is None else int(e))
+                       for r, e in notice.get("ranks", [])]
+            kind = notice.get("kind", "disconnect")
+            reason = notice.get("reason", "")
+        except (ValueError, TypeError, UnicodeDecodeError):
+            logger.warning("undecodable RL notice from relay %d", rid)
+            return
+        promote = []
+        now = time.monotonic()
+        with self._lock:
+            for rank, epoch in entries:
+                if rank in self._lost:
+                    continue
+                if self._rank_via.get(rank) != rid:
+                    continue  # re-homed elsewhere already
+                if epoch is not None and \
+                        self._via_epoch.get(rank) != epoch:
+                    continue  # stale notice about a superseded socket
+                if epoch is None:
+                    # Interior trouble: the reporter cannot prove
+                    # which leaves are actually affected.  Don't
+                    # detach — arm a suspicion deadline (detection
+                    # slack + grace) keyed to the attachment
+                    # generation; a resume bumps the generation and
+                    # clears it.
+                    self._via_suspect[rank] = \
+                        (now + self._subtree_slack() +
+                         self.reconnect_grace_s,
+                         self._conn_gen.get(rank, 0))
+                elif kind == "silent":
+                    # The LEAF itself went quiet on its direct parent:
+                    # the wedged-rank case, same verdict as the root's
+                    # own liveness on a direct link.
+                    self._rank_via.pop(rank, None)
+                    self._via_epoch.pop(rank, None)
+                    promote.append(rank)
+                elif self.reconnect_grace_s > 0:
+                    self._rank_via.pop(rank, None)
+                    self._via_epoch.pop(rank, None)
+                    self._enter_limbo_locked(rank)
+                else:
+                    self._rank_via.pop(rank, None)
+                    self._via_epoch.pop(rank, None)
+                    promote.append(rank)
+        for rank in promote:
+            self._count_departed(rank)
+            self._promote_lost(
+                rank, clean=False,
+                reason="relay %d reported %s (%s)" % (rid, kind,
+                                                      reason))
+
+    def _handle_metrics_aggregate(self, rid: int, payload: bytes):
+        try:
+            agg = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            logger.warning("undecodable MA frame from relay %d", rid)
+            return
+        with self._lock:
+            self._relay_metrics[rid] = {
+                "ranks": [int(r) for r in agg.get("ranks", [])],
+                "snapshot": agg.get("snapshot") or {}}
+
+    def _mux_close(self, token: "_LinkToken"):
+        if token.kind == "relay":
+            self._relay_link_down(token.ident, token.gen)
+        else:
+            self._rank_link_down(token.ident, token.gen, token.clean,
+                                 silent=False)
 
     def _try_resume(self, rank: int, sess: dict, conn: socket.socket):
         """Reconnect handshake: same session inside the grace window →
@@ -466,8 +1084,13 @@ class CoordinatorServer:
             # has not fully processed, and close its socket.  The rank
             # stays OUT of _conns for now: broadcasts must keep
             # accumulating in the out-log until the backlog below has
-            # been replayed, or the stream would reorder.
+            # been replayed, or the stream would reorder.  A prior
+            # relay attachment is superseded the same way (re-home
+            # from a dead relay to the root).
             old = self._conns.pop(rank, None)
+            self._rank_via.pop(rank, None)
+            self._via_epoch.pop(rank, None)
+            self._via_suspect.pop(rank, None)
             self._conn_gen[rank] = gen = \
                 self._conn_gen.get(rank, 0) + 1
             # Stay in limbo (fresh timestamp) until phase 3: limbo
@@ -524,7 +1147,7 @@ class CoordinatorServer:
         logger.info("rank %d control channel resumed (replayed %d "
                     "downlink frames)", rank, out_seq - recv_count)
         _RECONNECTS.inc(1, outcome="resumed")
-        self._spawn_rank_loop(rank, conn, gen)
+        self._serve_link(rank, conn, gen)
 
     def _spawn_rank_loop(self, rank: int, conn: socket.socket,
                          gen: Optional[int] = None):
@@ -583,6 +1206,16 @@ class CoordinatorServer:
                     return
                 magic, payload = frame
                 self._last_heard[rank] = time.monotonic()
+                if magic in _OOS_UP:
+                    # Out-of-stream: HB is a pure liveness signal, MR
+                    # an absolute snapshot — neither enters the stream
+                    # cursor (symmetric with the worker's up-log).
+                    _FRAMES_RECV.inc(1, kind=magic.decode(
+                        "ascii", "replace"))
+                    if magic == _MAGIC_METRICS_REP:
+                        self._handle_metrics_snapshot(rank, payload)
+                    continue
+                self.uplink_frames += 1
                 # Failpoint site: uplink frame arrival on the
                 # coordinator.  drop() discards the frame (the sender's
                 # tensor goes incomplete — the stall machinery must
@@ -620,15 +1253,9 @@ class CoordinatorServer:
                     if self._conn_gen.get(rank, 0) != gen:
                         return  # superseded mid-stream
                     try:
-                        if magic == _MAGIC_HB:
-                            continue  # pure liveness signal
                         if magic == _MAGIC_HITS:
                             self._handle_cache_hits(
                                 rank, unpack_bits(payload))
-                            continue
-                        if magic == _MAGIC_METRICS_REP:
-                            self._handle_metrics_snapshot(rank,
-                                                          payload)
                             continue
                         requests, shutdown = \
                             unpack_request_list(payload)
@@ -681,6 +1308,9 @@ class CoordinatorServer:
                 return False
             self._lost.add(rank)
             self._limbo.pop(rank, None)
+            self._rank_via.pop(rank, None)
+            self._via_epoch.pop(rank, None)
+            self._via_suspect.pop(rank, None)
             conn = self._conns.get(rank)
         if reason == "liveness timeout":
             _LIVENESS_TIMEOUTS.inc(1, role="coordinator")
@@ -715,27 +1345,77 @@ class CoordinatorServer:
     # ------------------------------------------------------------------
     # liveness sweep
     # ------------------------------------------------------------------
+    def _link_deadline_locked(self, key):
+        """Current true liveness deadline for a heap key — a direct
+        rank (int) or a relay link (("relay", rid) — depth-aware, so a
+        deep subtree's forwarding latency never false-promotes it).
+        None = the link is no longer tracked (caller holds
+        self._lock)."""
+        heard = self._last_heard.get(key)
+        if heard is None:
+            return None
+        if isinstance(key, tuple):
+            rid = key[1]
+            if rid not in self._relay_conns:
+                return None
+            return heard + env_mod.depth_aware_liveness_timeout(
+                self.liveness_timeout_s, self._relay_depth.get(rid, 1))
+        if key not in self._conns:
+            return None  # relay-attached ranks are watched per hop
+        return heard + self.liveness_timeout_s
+
     def _liveness_loop(self):
         """Coordinator half of bounded-time liveness: broadcast HB
         when the downlink has been idle (so workers can bound their
         own recv waits), promote silent ranks and expired limbo ranks
-        to lost, and bound the formation wait by the start timeout."""
+        to lost, and bound the formation wait by the start timeout.
+        The silent scan rides the lazy deadline heap — each tick
+        visits only links whose recorded deadline lapsed, O(due)
+        instead of O(world) per interval."""
         period = self._sweep_period()
         hb_armed = self.liveness_interval_s > 0
         while not self._stop.wait(period):
             now = time.monotonic()
             with self._lock:
                 silent = []
+                silent_relays = []
                 if hb_armed:
                     if now - self._last_broadcast_t >= \
                             self.liveness_interval_s:
                         self._broadcast_frame_locked(_MAGIC_HB, b"")
                         _HEARTBEATS.inc(1, role="coordinator")
-                    silent = [r for r, t in self._last_heard.items()
-                              if r in self._conns and
-                              now - t > self.liveness_timeout_s]
+                    for key in self._lheap.due(
+                            now, self._link_deadline_locked):
+                        if isinstance(key, tuple):
+                            silent_relays.append(
+                                (key[1],
+                                 self._relay_gen.get(key[1], 0)))
+                        else:
+                            silent.append(key)
                 expired = [r for r, t in self._limbo.items()
                            if now - t > self.reconnect_grace_s]
+                # Suspicion clocks (interior relay trouble reported
+                # without per-socket proof): a resume bumps the
+                # attachment generation and clears the suspicion;
+                # deadline expiry without one promotes.
+                suspect_expired = []
+                for r, (deadline, gen) in \
+                        list(self._via_suspect.items()):
+                    if self._conn_gen.get(r, 0) != gen:
+                        self._via_suspect.pop(r, None)
+                    elif now > deadline:
+                        self._via_suspect.pop(r, None)
+                        self._rank_via.pop(r, None)
+                        self._via_epoch.pop(r, None)
+                        suspect_expired.append(r)
+            for rid, gen in silent_relays:
+                self._relay_link_down(rid, gen,
+                                      reason="liveness timeout")
+            for rank in suspect_expired:
+                if self._promote_lost(rank, clean=False,
+                                      reason="subtree suspicion "
+                                             "expired"):
+                    self._count_departed(rank)
             for rank in silent:
                 if self._promote_lost(rank, clean=False,
                                       reason="liveness timeout"):
@@ -771,7 +1451,7 @@ class CoordinatorServer:
             if self._formed:
                 return
             missing = sorted(set(range(self.size)) -
-                             set(self._conns.keys()))
+                             self._attached_ranks_locked())
             # Log once even with nothing buffered: an idle formation
             # hang past the deadline must leave a trace (the sweep
             # re-evaluates every period).
@@ -824,14 +1504,30 @@ class CoordinatorServer:
 
     def merged_metrics(self) -> Optional[dict]:
         """Sum of the latest per-rank snapshots (None until the first
-        MR frame lands).  ``ranks`` names the contributors, so a
-        scraper can tell a partial merge from a full one."""
+        MR/MA frame lands).  ``ranks`` names the contributors, so a
+        scraper can tell a partial merge from a full one.  In tree
+        mode, relays pre-aggregate their subtree's MR replies into one
+        MA frame each, so this merge is O(fanout) snapshots at the
+        root instead of O(world)."""
         with self._lock:
             snaps = dict(self._rank_metrics)
-        if not snaps:
+            aggs = dict(self._relay_metrics)
+        if not snaps and not aggs:
             return None
-        merged = metrics.merge_snapshots(snaps[r] for r in sorted(snaps))
-        merged["ranks"] = sorted(snaps)
+        parts = [snaps[r] for r in sorted(snaps)]
+        ranks = set(snaps)
+        for rid in sorted(aggs):
+            parts.append(aggs[rid].get("snapshot") or {})
+            ranks.update(aggs[rid].get("ranks", []))
+        # Known transient: for up to one poll interval after a leaf
+        # re-homes from a live relay to a direct root link, its
+        # contribution may appear both in the relay's last MA
+        # aggregate and as a fresh direct MR (aggregates are merged
+        # sums — a single rank cannot be subtracted out).  The next
+        # MQ poll re-converges; the reverse transition is cleaned
+        # eagerly in the remote attach paths.
+        merged = metrics.merge_snapshots(parts)
+        merged["ranks"] = sorted(ranks)
         return merged
 
     def _on_rank_lost(self, rank: int, clean: bool,
@@ -1247,8 +1943,39 @@ class CoordinatorServer:
                                "error; dropping the frame")
                 return
         self._last_broadcast_t = time.monotonic()
+        t0 = time.perf_counter_ns()
         sent = 0
-        if self.reconnect_grace_s > 0:
+        if self._tree:
+            # Relay tree: ONE send per root link — O(fanout) relay
+            # links plus the direct leaves (rank 0's loopback and any
+            # re-homed stragglers); relays fan the frame down.  The
+            # out-log still records per RANK (relays are stateless),
+            # so any leaf can resume against the root after its relay
+            # dies.
+            if self.reconnect_grace_s > 0:
+                for r in set(self._conns) | set(self._rank_via) | \
+                        set(self._limbo):
+                    self._log_out_locked(r, magic, payload)
+            dead = []
+            for r, conn in self._conns.items():
+                try:
+                    _send_frame(conn, magic, payload)
+                    sent += 1
+                except OSError:
+                    dead.append(r)
+            for r in dead:
+                if self.reconnect_grace_s > 0 and \
+                        r not in self._lost:
+                    self._enter_limbo_locked(r)
+                else:
+                    self._conns.pop(r, None)
+            for rid, conn in self._relay_conns.items():
+                try:
+                    _send_frame(conn, magic, payload)
+                    sent += 1
+                except OSError:
+                    pass  # the mux reaps the dead relay link
+        elif self.reconnect_grace_s > 0:
             # Limbo ranks have no live socket but stay in the fan-out:
             # the frame enters their out-log, so a resume inside the
             # grace window replays it and the rank never falls out of
@@ -1269,6 +1996,8 @@ class CoordinatorServer:
                     dead.append(r)
             for r in dead:
                 self._conns.pop(r, None)
+        self.bcast_ns += time.perf_counter_ns() - t0
+        self.bcast_sends += sent
         if sent:
             # Coordinator fan-out is the dominant control-plane send
             # volume on rank 0 — account it next to the worker-side
@@ -1297,7 +2026,7 @@ class CoordinatorServer:
             return False
 
     def _log_out_locked(self, rank: int, magic: bytes, payload: bytes):
-        if self.reconnect_grace_s <= 0:
+        if self.reconnect_grace_s <= 0 or magic in _OOS_DOWN:
             return
         log = self._out_log.get(rank)
         if log is None:
@@ -1321,15 +2050,15 @@ class CoordinatorServer:
             age = time.monotonic() - self._started_at
             if age < self._stall_warning_s:
                 return
-            missing = sorted(set(range(self.size)) -
-                             set(self._conns.keys()))
+            attached = self._attached_ranks_locked()
+            missing = sorted(set(range(self.size)) - attached)
             last = self._stall_logged.get(("__formation__",), 0.0)
             if age - last >= self._stall_warning_s or last == 0:
                 self._stall_logged[("__formation__",)] = age
                 logger.warning(
                     "STALL: waiting for ranks %s to connect for %.0fs "
                     "(%d/%d registered, %d requests buffered)",
-                    missing, age, len(self._conns), self.size,
+                    missing, age, len(attached), self.size,
                     len(self._pre_formed))
             if 0 < self._stall_shutdown_s <= age:
                 pre, self._pre_formed = self._pre_formed, []
@@ -1421,12 +2150,17 @@ class CoordinatorServer:
         except OSError:
             pass
         with self._lock:
-            for conn in self._conns.values():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+            conns = list(self._conns.values()) + \
+                list(self._relay_conns.values())
             self._conns.clear()
+            self._relay_conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._mux is not None:
+            self._mux.stop()
 
 
 class NetworkController(Controller):
@@ -1464,8 +2198,20 @@ class NetworkController(Controller):
         # precedent, asserted by tests/test_liveness.py).
         knobs = state.knobs
         self._liveness_interval_s = knobs.liveness_interval_s
-        self._liveness_timeout_s = knobs.liveness_timeout_s
+        # Relay tree (HOROVOD_COORD_FANOUT, common/relay.py): this
+        # rank's parent may be a relay; re-homing walks the ancestor
+        # chain toward the root.  The coordinator-silence deadline is
+        # depth-aware — each relay hop adds forwarding latency (and
+        # one possible failover) between the root's heartbeat and us.
+        self._fanout = getattr(knobs, "coord_fanout", 0)
+        self._plan = relay_mod.plan_tree(self.size, self._fanout) \
+            if self._fanout > 0 else None
+        self._hops = self._plan.leaf_hops(self.rank) \
+            if (self._plan is not None and self.rank != 0) else 0
+        self._liveness_timeout_s = env_mod.depth_aware_liveness_timeout(
+            knobs.liveness_timeout_s, self._hops)
         self._grace_s = knobs.reconnect_grace_s
+        self._hosted_relays: List = []
         self._selfheal = True if (self._liveness_interval_s > 0 or
                                   self._grace_s > 0) else None
         self._session_id = "%016x" % random.getrandbits(64)
@@ -1505,6 +2251,7 @@ class NetworkController(Controller):
             self._publish_actual_addr(addr, self.server.port)
             host = "127.0.0.1"
             self._addr = (host, self.server.port)
+            self._host_relays(state, addr)
         else:
             resolved = self._resolve_addr(addr)
             if not resolved:
@@ -1513,6 +2260,8 @@ class NetworkController(Controller):
                     "runs (the launcher sets it automatically).")
             host, port = resolved.rsplit(":", 1)
             self._addr = (host, int(port))
+            self._host_relays(state, resolved)
+        self._addr_chain = self._build_addr_chain()
         self._sock = self._connect()
         self._recv_buf: "queue.Queue" = queue.Queue()
         self._on_receive = None
@@ -1609,9 +2358,20 @@ class NetworkController(Controller):
                 "HOROVOD_LIVENESS_INTERVAL/HOROVOD_RECONNECT_GRACE: "
                 "the self-healing control plane requires the Python "
                 "coordinator (HB/WE frames).  Unset one of the two.")
+        # The relay tree is Python-coordinator-only too: the native
+        # server has no RB/RD/RL relay frames, so a relay registering
+        # against it would kill the link.  Same gating rule as the
+        # other Python-only features above.
+        tree = getattr(state.knobs, "coord_fanout", 0) > 0
+        if strict_native and tree:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_COORD_FANOUT>0: the relay-tree control plane "
+                "requires the Python coordinator (relay frames).  "
+                "Unset one of the two.")
         if state.timeline is None and param_manager is None and \
                 metrics_interval <= 0 and not _fp.ENABLED and \
-                not selfheal:
+                not selfheal and not tree:
             try:
                 from ..native import NativeCoordinatorServer, available
                 if strict_native and not available():
@@ -1651,6 +2411,7 @@ class NetworkController(Controller):
             liveness_timeout_s=state.knobs.liveness_timeout_s,
             reconnect_grace_s=state.knobs.reconnect_grace_s,
             registration_timeout_s=state.knobs.registration_timeout_s,
+            fanout=getattr(state.knobs, "coord_fanout", 0),
             on_rank_lost=self._make_rank_lost_publisher(state))
 
     def _make_rank_lost_publisher(self, state):
@@ -1664,9 +2425,7 @@ class NetworkController(Controller):
         if client is None:
             return None
 
-        def hook(rank, clean, reason, _client=client):
-            if clean:
-                return
+        def publish(rank, reason, _client=client):
             try:
                 from ..runner.elastic.worker import current_epoch
                 epoch = current_epoch()
@@ -1684,6 +2443,18 @@ class NetworkController(Controller):
                 logger.warning("could not publish the lost-rank "
                                "notice to the rendezvous KV",
                                exc_info=True)
+
+        def hook(rank, clean, reason):
+            if clean:
+                return
+            # Publish OFF the calling thread: the hook runs from frame
+            # dispatch (in tree mode the single mux recv thread; in
+            # flat mode a rank loop) and a slow/partitioned rendezvous
+            # would otherwise block control-plane processing for the
+            # client's full HTTP timeout.
+            threading.Thread(target=publish, args=(rank, reason),
+                             name="hvd-lost-publish", daemon=True
+                             ).start()
 
         return hook
 
@@ -1731,6 +2502,105 @@ class NetworkController(Controller):
                                "failed; using env value")
         return env_addr
 
+    def _host_relays(self, state, env_addr):
+        """Launcher runs: designated host ranks start their relays
+        in-process and publish the addresses through the rendezvous
+        KV.  Skipped entirely when HOROVOD_RELAY_ADDRS is set (a
+        harness/launcher owns the relays) or when there is no KV to
+        publish through (leaves then fall back to direct root links —
+        degraded but correct)."""
+        if self._plan is None or relay_mod.relay_addr_map():
+            return
+        mine = self._plan.relays_hosted_by(self.rank)
+        if not mine:
+            return
+        client = self._rendezvous_client()
+        if client is None:
+            logger.warning(
+                "HOROVOD_COORD_FANOUT=%d requested but neither "
+                "HOROVOD_RELAY_ADDRS nor a rendezvous KV is "
+                "available to place relays; every rank will link "
+                "directly to rank 0 (flat star)", self._fanout)
+            return
+        # Publish relays at THIS worker's address, not the
+        # coordinator's: on a multi-host launch the hosting rank lives
+        # on its own machine (the launcher's hostname contract names
+        # it); env_addr's host is only right for rank 0 — and for
+        # single-host runs, where everything shares it.
+        host = os.environ.get(env_mod.HOROVOD_HOSTNAME)
+        if not host:
+            host = env_addr.rsplit(":", 1)[0] if env_addr \
+                else "127.0.0.1"
+        root_addr = "%s:%d" % self._addr if self.rank == 0 \
+            else (env_addr or "")
+        local: Dict[int, str] = {}
+        knobs = self.state.knobs
+        for rid in mine:  # highest level first: parents before kids
+            chain = []
+            for anc in self._plan.relay_ancestors(rid):
+                if anc in local:
+                    chain.append(local[anc])
+                    continue
+                try:
+                    chain.append(client.wait_get(
+                        self._ctrl_scope(), "relay.%d" % anc,
+                        timeout=env_mod.start_timeout()).decode())
+                except (OSError, TimeoutError):
+                    logger.warning("relay %d: ancestor %d address "
+                                   "never appeared; climbing past it",
+                                   rid, anc)
+            if root_addr:
+                chain.append(root_addr)
+            try:
+                rs = relay_mod.RelayServer(
+                    rid, chain, bind_addr="0.0.0.0",
+                    liveness_interval_s=knobs.liveness_interval_s,
+                    liveness_timeout_s=knobs.liveness_timeout_s,
+                    registration_timeout_s=(
+                        knobs.registration_timeout_s),
+                    depth_below=self._plan.relays[rid].depth_below)
+            except (OSError, ConnectionError):
+                logger.warning("could not start relay %d; its leaves "
+                               "will fall back to ancestors",
+                               rid, exc_info=True)
+                continue
+            addr = "%s:%d" % (host, rs.port)
+            local[rid] = addr
+            self._hosted_relays.append(rs)
+            try:
+                client.put(self._ctrl_scope(), "relay.%d" % rid,
+                           addr.encode())
+            except OSError:
+                logger.warning("could not publish relay %d address",
+                               rid, exc_info=True)
+
+    def _build_addr_chain(self) -> List[Tuple[str, int]]:
+        """This rank's connection targets, nearest parent first, the
+        root always last: [relay, grandparent relay, ..., root].
+        Re-homing escalates through it (docs/failure_recovery.md)."""
+        chain: List[Tuple[str, int]] = []
+        if self._plan is not None and self.rank != 0:
+            amap = relay_mod.relay_addr_map()
+            client = None if amap else self._rendezvous_client()
+            for rid in self._plan.ancestors_of_leaf(self.rank):
+                addr = amap.get(rid)
+                if addr is None and client is not None:
+                    try:
+                        addr = client.wait_get(
+                            self._ctrl_scope(), "relay.%d" % rid,
+                            timeout=env_mod.start_timeout()).decode()
+                    except (OSError, TimeoutError):
+                        addr = None
+                if addr and ":" in addr:
+                    h, p = addr.rsplit(":", 1)
+                    chain.append((h, int(p)))
+                else:
+                    logger.warning("no address for relay %d; rank %d "
+                                   "will skip that hop", rid,
+                                   self.rank)
+        chain.append(self._addr)
+        return chain
+
     def _registration_payload(self, resume: bool) -> bytes:
         """Rank id, plus the session blob when the self-healing channel
         is on.  The native coordinator reads only the first 4 bytes, so
@@ -1757,41 +2627,69 @@ class NetworkController(Controller):
             s.settimeout(None)
 
     def _connect(self) -> socket.socket:
-        # The start timeout bounds the wait for the coordinator to
-        # come up (launcher --start-timeout; reference launch.py
-        # start_timeout contract).
+        # The start timeout bounds the wait for the coordinator (or
+        # this rank's relay) to come up (launcher --start-timeout;
+        # reference launch.py start_timeout contract).  With a relay
+        # tree, the assigned relay is preferred for a patience window
+        # before escalating toward the root — an immediate root
+        # fallback at startup would quietly flatten the topology.
         timeout_s = env_mod.start_timeout()
-        deadline = time.monotonic() + timeout_s
+        start = time.monotonic()
+        deadline = start + timeout_s
+        # Wall-clock patience for the assigned relay (NOT an attempt
+        # count: connection-refused fails in microseconds, and relay
+        # bring-up on another host can legitimately take a while —
+        # serial RelayServer starts gated on KV address waits).
+        patience_s = min(max(timeout_s / 4.0, 5.0), 30.0) \
+            if len(self._addr_chain) > 1 else 0.0
         last_err = None
         while time.monotonic() < deadline:
-            try:
-                s = socket.create_connection(self._addr, timeout=5.0)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._arm_sock(s)
-                _send_frame(s, _MAGIC_REQ,
-                            self._registration_payload(resume=False))
-                self._last_recv_t = time.monotonic()
-                return s
-            except OSError as e:
-                last_err = e
-                time.sleep(0.2)
+            reach = 1 if time.monotonic() - start < patience_s \
+                else len(self._addr_chain)
+            for addr in self._addr_chain[:reach]:
+                try:
+                    s = socket.create_connection(addr, timeout=5.0)
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                    self._arm_sock(s)
+                    _send_frame(
+                        s, _MAGIC_REQ,
+                        self._registration_payload(resume=False))
+                    self._last_recv_t = time.monotonic()
+                    return s
+                except OSError as e:
+                    last_err = e
+            time.sleep(0.2)
         raise ConnectionError(
-            f"could not reach coordinator at {self._addr}: {last_err}")
+            f"could not reach coordinator via {self._addr_chain}: "
+            f"{last_err}")
 
     def _reconnect(self) -> bool:
         """The control socket died mid-incarnation: retry with
         jittered exponential backoff inside the grace window, resume
         the session (coordinator replays the downlink we missed, we
         replay the uplink it never processed), and hand the new socket
-        back to the recv loop.  Returns False when the window expires
-        or the coordinator refuses the resume — the caller then runs
-        the legacy broken-membership path."""
+        back to the recv loop.  With a relay tree, retries *re-home*:
+        the first attempts go to the assigned relay (a blip heals in
+        place), then escalate up the ancestor chain — grandparent
+        relay, finally the root, which holds every rank's session
+        state (relays are stateless, so the resume is identical at any
+        hop).  Returns False when the window expires or the
+        coordinator refuses the resume — the caller then runs the
+        legacy broken-membership path."""
         deadline = time.monotonic() + self._grace_s
         try:
             self._sock.close()
         except OSError:
             pass
         attempt = 0
+        chain = self._addr_chain
+        target_idx = 0
+        # Hops that accepted TCP but never answered the WE handshake
+        # are wedged (SIGSTOP'd relay: its accept thread lives, its
+        # forwarding is frozen) — skip them for the rest of this
+        # episode instead of burning the grace window on them again.
+        wedged_hops = set()
         while not self._closing:
             attempt += 1
             backoff = min(0.05 * (2 ** (attempt - 1)), 1.0)
@@ -1799,16 +2697,46 @@ class NetworkController(Controller):
             if time.monotonic() + backoff >= deadline:
                 break
             time.sleep(backoff)
+            # Escalate one hop every other failed attempt; the last
+            # chain entry is always the root.
+            target_idx = min((attempt - 1) // 2, len(chain) - 1)
+            while target_idx in wedged_hops and \
+                    target_idx < len(chain) - 1:
+                target_idx += 1
             try:
-                s = socket.create_connection(self._addr, timeout=2.0)
+                s = socket.create_connection(chain[target_idx],
+                                             timeout=2.0)
             except OSError:
                 continue
             try:
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(max(self._grace_s, 2.0))
+                # The WE answer from a healthy path arrives in
+                # milliseconds; cap the wait well below the grace
+                # window so one unresponsive (wedged) hop leaves
+                # enough budget to climb to an ancestor.
+                s.settimeout(max(0.25, min(
+                    2.0, self._grace_s / 3.0,
+                    deadline - time.monotonic())))
                 _send_frame(s, _MAGIC_REQ,
                             self._registration_payload(resume=True))
-                frame = _recv_frame(s)
+                try:
+                    frame = _recv_frame(s)
+                except socket.timeout:
+                    # Branding the hop wedged is deliberately eager: a
+                    # false positive (the hop was healthy but the root
+                    # was backlogged replaying a thundering herd of
+                    # resumes) only costs climbing to an ancestor —
+                    # sessions live on the root, so a resume succeeds
+                    # identically at ANY hop, and the root itself is
+                    # never skippable.
+                    if target_idx < len(chain) - 1:
+                        wedged_hops.add(target_idx)
+                        logger.warning(
+                            "resume via hop %d accepted but never "
+                            "answered; climbing the ancestor chain",
+                            target_idx)
+                    s.close()
+                    continue
                 if frame is None or frame[0] != _MAGIC_WELCOME:
                     s.close()
                     continue
@@ -1836,10 +2764,16 @@ class NetworkController(Controller):
                     self._sock = s
                 self._last_recv_t = time.monotonic()
                 logger.info(
-                    "control channel resumed after %d attempt(s) "
-                    "(replayed %d uplink frames)", attempt,
+                    "control channel resumed after %d attempt(s) via "
+                    "%s (replayed %d uplink frames)", attempt,
+                    "parent" if target_idx == 0 else
+                    ("ancestor %d" % target_idx),
                     self._up_count - acked)
                 _RECONNECTS.inc(1, outcome="resumed")
+                if len(chain) > 1:
+                    relay_mod._REHOMES.inc(
+                        1, outcome="resumed_parent" if target_idx == 0
+                        else "resumed_ancestor")
                 return True
             except (OSError, ValueError):
                 try:
@@ -1851,6 +2785,8 @@ class NetworkController(Controller):
             logger.warning("control channel could not be re-established "
                            "within the %.1fs grace window", self._grace_s)
             _RECONNECTS.inc(1, outcome="failed")
+            if len(chain) > 1:
+                relay_mod._REHOMES.inc(1, outcome="failed")
         return False
 
     # ------------------------------------------------------------------
@@ -2012,10 +2948,17 @@ class NetworkController(Controller):
             self._last_recv_t = time.monotonic()
             if magic == _MAGIC_WELCOME:
                 continue  # handshake-only frame; not part of the stream
-            self._recv_count += 1
             if magic == _MAGIC_HB:
                 _FRAMES_RECV.inc(1, kind="HB")
-                continue  # pure liveness signal
+                continue  # out-of-stream liveness signal
+            if magic == _MAGIC_METRICS_REQ:
+                # Out-of-stream metrics poll: absolute snapshots need
+                # no replay, and keeping MQ/MR outside the stream
+                # cursors is what lets relays aggregate them.
+                _FRAMES_RECV.inc(1, kind="MQ")
+                self._spawn_metrics_reply()
+                continue
+            self._recv_count += 1
             # Failpoint site: downlink frame arrival on a worker.
             # drop() loses one response/cache frame for THIS rank only
             # — it falls out of lockstep with its peers, the shape of
@@ -2036,9 +2979,6 @@ class NetworkController(Controller):
             self.stats["bytes_recv"] += len(payload) + 6
             _BYTES_RECV.inc(len(payload) + 6)
             _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
-            if magic == _MAGIC_METRICS_REQ:
-                self._spawn_metrics_reply()
-                continue
             if magic == _MAGIC_CACHE:
                 self.stats["cb_frames"] += 1
                 batches = unpack_bit_batches(payload)
@@ -2121,7 +3061,7 @@ class NetworkController(Controller):
         frame is in the up-log; the handshake replays it, so a
         transient drop is invisible to the submitting thread)."""
         self._last_uplink_t = time.monotonic()
-        if self._grace_s > 0:
+        if self._grace_s > 0 and magic not in _OOS_UP:
             self._up_count += 1
             self._up_log.append((self._up_count, magic, payload))
             try:
@@ -2130,6 +3070,9 @@ class NetworkController(Controller):
                 logger.debug("uplink send hit a dead socket; frame "
                              "queued for resume replay")
         else:
+            # Out-of-stream (HB/MR) frames are never logged/replayed:
+            # a lost heartbeat is re-sent next interval, a lost
+            # snapshot is re-covered by the next poll.
             _send_frame(self._sock, magic, payload)
 
     def _spawn_metrics_reply(self):
@@ -2354,6 +3297,14 @@ class NetworkController(Controller):
         if self.server is not None:
             self._drain_server()
             self.server.stop()
+        # Hosted relays stop LAST: peer ranks' shutdown frames may
+        # still be riding them while the coordinator drains.
+        for rs in self._hosted_relays:
+            try:
+                rs.shutdown()
+            except Exception:
+                logger.warning("relay shutdown failed", exc_info=True)
+        self._hosted_relays = []
 
     # Grace window: if the set of ever-connected ranks is stagnant and
     # all of them departed, remaining ranks crashed before connecting —

@@ -82,6 +82,47 @@ HOROVOD_RECONNECT_GRACE = "HOROVOD_RECONNECT_GRACE"
 # and never identifies its rank is cut after this many seconds
 # (previously a hardcoded 30 s).
 HOROVOD_REGISTRATION_TIMEOUT = "HOROVOD_REGISTRATION_TIMEOUT"
+# Relay-tree control plane (docs/architecture.md): interior relay
+# nodes — one per simulated "host", arity bounded by this knob —
+# aggregate their children's CH/RQ/MQ uplinks and fan CB/RS/HB
+# broadcasts down, so rank 0 touches O(fanout) links instead of
+# O(world).  0 (default) = the flat star (every rank links directly
+# to rank 0, byte-identical to the pre-tree wire behavior); > 0 pins
+# the Python coordinator (the native one has no relay frames — same
+# gating as liveness/autotune/metrics aggregation).  Worlds of
+# size <= fanout + 1 stay flat even when set (a relay would not
+# reduce the root's link count).
+HOROVOD_COORD_FANOUT = "HOROVOD_COORD_FANOUT"
+# Relay address map for launchers/harnesses that pre-assign relay
+# ports: a JSON object {"<relay_id>": "host:port", ...}.  When set,
+# workers resolve relay addresses from it and NO rank self-hosts a
+# relay (the harness owns them); when unset, designated host ranks
+# start relays in-process and publish their addresses through the
+# rendezvous KV (key ``relay.<id>`` in the controller scope).
+HOROVOD_RELAY_ADDRS = "HOROVOD_RELAY_ADDRS"
+# Depth-aware liveness deadlines: every relay hop adds forwarding
+# latency (and, during a re-home, up to one grace window) between a
+# peer's heartbeat and its observer, so a depth-blind timeout would
+# false-promote healthy subtrees behind a busy relay.  The effective
+# deadline a node applies to a link grows linearly with the number of
+# relay hops the watched traffic crosses:
+#
+#     effective_timeout(base, hops) = base * (1 + HOP_SLACK * hops)
+#
+# hops = 0 is a direct link (flat star and the root's leaf links —
+# exactly the pre-tree behavior); a leaf at depth d watches the
+# coordinator through d relay hops; the root watches a relay link
+# with the subtree's depth below it.  The detection-bound table by
+# depth lives in docs/failure_recovery.md.
+LIVENESS_HOP_SLACK = 0.5
+
+
+def depth_aware_liveness_timeout(base_timeout_s: float,
+                                 hops: int) -> float:
+    """Effective liveness deadline for a link whose watched traffic
+    crosses ``hops`` relay hops (see LIVENESS_HOP_SLACK above for the
+    formula; hops=0 reproduces the flat-star deadline exactly)."""
+    return base_timeout_s * (1.0 + LIVENESS_HOP_SLACK * max(0, int(hops)))
 # Differential checkpoints: the longest base→tip delta chain before
 # the manager forces the next save to be a full base (bounds restore
 # replay cost and the blast radius of a corrupt base).  0 = deltas
@@ -250,6 +291,7 @@ class Knobs:
     liveness_timeout_s: float = 0.0    # 0 -> 2x interval
     reconnect_grace_s: float = 0.0     # 0 -> liveness timeout
     registration_timeout_s: float = 30.0
+    coord_fanout: int = 0              # 0 = flat star (no relay tree)
 
     def __post_init__(self):
         if not self.liveness_timeout_s:
@@ -299,4 +341,5 @@ class Knobs:
             reconnect_grace_s=reconnect_grace,
             registration_timeout_s=env_float(
                 HOROVOD_REGISTRATION_TIMEOUT, 30.0),
+            coord_fanout=max(0, env_int(HOROVOD_COORD_FANOUT, 0)),
         )

@@ -397,10 +397,23 @@ class ElasticDriver:
         with self._lock:
             slots = [s for ss in self._host_assignments.values()
                      for s in ss]
+        # One scope listing per tick instead of one GET per slot: the
+        # poll is O(notices present), not O(world) — at 64-256 ranks
+        # (relay-tree worlds) the per-slot form was the driver's own
+        # flat-star scan.  A whole subtree promoted at once (relay
+        # loss past grace) lands as several notices in one listing.
+        try:
+            present = set(self._rendezvous.kvstore.keys(ELASTIC_SCOPE))
+        except Exception:
+            logger.warning("elastic: lost-rank listing failed; will "
+                           "retry next tick", exc_info=True)
+            return
         for slot in slots:
+            key = KEY_LOST_RANK % slot.rank
+            if key not in present:
+                continue
             try:
-                raw = self._rendezvous.kvstore.get(
-                    ELASTIC_SCOPE, KEY_LOST_RANK % slot.rank)
+                raw = self._rendezvous.kvstore.get(ELASTIC_SCOPE, key)
             except Exception:
                 # Per-slot, logged, and non-aborting: a KV hiccup must
                 # not silently disable wedged-host eviction (the

@@ -192,6 +192,14 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             return
         scope, key = self._split()
         special = self.handle_get_special(scope, key)
+        if special is None and key == "__keys__":
+            # Scope listing: one request replaces O(world) per-key
+            # polls in gather loops (checkpoint prepare marks, elastic
+            # lost-rank notices).  Reserved key; real keys never use
+            # the dunder form.
+            import json as _json
+            special = _json.dumps(sorted(
+                self.server.kvstore.keys(scope))).encode()
         value = special if special is not None \
             else self.server.kvstore.get(scope, key)
         if value is None:
@@ -326,6 +334,19 @@ class RendezvousClient:
             if e.code == NOT_FOUND:
                 return None
             raise
+
+    def keys(self, scope: str):
+        """List the scope's keys in ONE request (the ``__keys__``
+        special key) — gather loops use it to poll O(1) instead of
+        one GET per expected rank per tick."""
+        import json as _json
+        raw = self.get(scope, "__keys__")
+        if raw is None:
+            return []
+        try:
+            return [str(k) for k in _json.loads(raw.decode())]
+        except (ValueError, UnicodeDecodeError):
+            return []
 
     def wait_get(self, scope: str, key: str,
                  timeout: float = 120.0) -> bytes:

@@ -328,6 +328,63 @@ def test_mttr_matrix_full():
 
 
 # ---------------------------------------------------------------------------
+# out-of-stream frames (HB/MQ/MR excluded from the replay rings)
+# ---------------------------------------------------------------------------
+
+def test_heartbeats_are_out_of_stream():
+    """HB/MR frames must not enter the replay rings or stream
+    cursors on either side (what lets a relay consume/aggregate them
+    without desyncing resume arithmetic): a world idling on pure
+    heartbeats accumulates NOTHING in its up-logs or out-logs, and a
+    transient drop after heavy HB traffic still resumes gapless."""
+    world = ChaosWorld(3, stall_shutdown_s=6.0,
+                       liveness_interval_s=0.2,
+                       reconnect_grace_s=1.0)
+    try:
+        import threading
+
+        def one_round(tag):
+            outs, ts = {}, []
+            for r in range(3):
+                def go(r=r):
+                    outs[r] = world.collective(
+                        r, "allreduce", tag,
+                        np.full((7,), r + 1.0, np.float32), 0, 15.0)
+                t = threading.Thread(target=go, daemon=True)
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=20)
+            return outs
+
+        one_round("oos.a")
+        ctrl = world.runtimes[1].controller
+        srv = world.runtimes[0].controller.server
+        up0 = ctrl._up_count
+        out0 = srv._out_seq.get(1, 0)
+        # Idle long enough for several HB intervals both ways.
+        time.sleep(1.0)
+        assert ctrl._up_count == up0, \
+            "worker up-log grew on pure heartbeats"
+        assert srv._out_seq.get(1, 0) == out0, \
+            "coordinator out-log grew on pure heartbeats"
+        # And a drop after all that HB traffic still resumes cleanly.
+        resumed_c = hm.REGISTRY.counter("hvd_reconnects_total")
+        before = resumed_c.value(outcome="resumed")
+        world.sever_rank(1)
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline and \
+                resumed_c.value(outcome="resumed") <= before:
+            time.sleep(0.05)
+        assert resumed_c.value(outcome="resumed") >= before + 1
+        outs = one_round("oos.b")
+        np.testing.assert_allclose(
+            outs[0], np.full((7,), 6.0, np.float32))
+    finally:
+        world.close()
+
+
+# ---------------------------------------------------------------------------
 # zero-overhead-when-disabled (the PR 2 precedent)
 # ---------------------------------------------------------------------------
 

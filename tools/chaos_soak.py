@@ -219,11 +219,13 @@ def _free_port() -> int:
 def soak_knobs(stall_shutdown_s: float,
                liveness_interval_s: float = 0.0,
                liveness_timeout_s: float = 0.0,
-               reconnect_grace_s: float = 0.0) -> Knobs:
+               reconnect_grace_s: float = 0.0,
+               coord_fanout: int = 0) -> Knobs:
     """Robustness machinery tightened to soak time scales: a dropped
     frame must surface through stall shutdown in seconds, not the
     production 60s.  MTTR/liveness drills additionally arm HB
-    heartbeats + the reconnect grace window at sub-second cadence."""
+    heartbeats + the reconnect grace window at sub-second cadence;
+    relay drills arm the fan-out tree."""
     return Knobs(
         cache_capacity=1024,
         cycle_time_ms=1.0,
@@ -234,6 +236,7 @@ def soak_knobs(stall_shutdown_s: float,
         liveness_interval_s=liveness_interval_s,
         liveness_timeout_s=liveness_timeout_s,
         reconnect_grace_s=reconnect_grace_s,
+        coord_fanout=coord_fanout,
     )
 
 
@@ -244,7 +247,9 @@ class ChaosWorld:
     def __init__(self, size: int, stall_shutdown_s: float = 4.0,
                  exchange_timeout_s: float = 8.0,
                  liveness_interval_s: float = 0.0,
-                 reconnect_grace_s: float = 0.0):
+                 reconnect_grace_s: float = 0.0,
+                 fanout: int = 0):
+        from horovod_tpu.common import relay as relay_mod
         from horovod_tpu.common.runtime import BackgroundRuntime
 
         self.size = size
@@ -255,12 +260,52 @@ class ChaosWorld:
         self._set_env("HOROVOD_START_TIMEOUT", "30")
         self._set_env("HOROVOD_GLOO_RENDEZVOUS_ADDR", None)
         self._set_env("HOROVOD_GLOO_RENDEZVOUS_PORT", None)
+        # Relay tree: the harness owns the relays (standalone objects
+        # it can kill/wedge independently of any worker rank — a real
+        # deployment's per-host relay process); the shared env addr
+        # map is how every thread-rank finds its assigned parent.
+        self.plan = relay_mod.plan_tree(size, fanout) if fanout else None
+        self.relays = {}
+        relay_ports = {}
+        if self.plan is not None:
+            relay_ports = {rid: _free_port() for rid in self.plan.relays}
+            self._set_env("HOROVOD_RELAY_ADDRS", json.dumps(
+                {str(rid): "127.0.0.1:%d" % p
+                 for rid, p in relay_ports.items()}))
+        else:
+            self._set_env("HOROVOD_RELAY_ADDRS", None)
+            fanout = 0
         knobs = soak_knobs(stall_shutdown_s,
                            liveness_interval_s=liveness_interval_s,
-                           reconnect_grace_s=reconnect_grace_s)
+                           reconnect_grace_s=reconnect_grace_s,
+                           coord_fanout=fanout)
         self.runtimes = []
         try:
-            for rank in range(size):  # rank 0 first: it hosts the server
+            # rank 0 first: it hosts the coordinator ...
+            st = _StateStub(0, size, knobs)
+            st.backend = SimBackend(0, size, self.exchanger)
+            rt = BackgroundRuntime(st)
+            rt.start()
+            self.runtimes.append(rt)
+            # ... then the relays (top level first, parents before
+            # children), then the remaining leaf ranks.
+            if self.plan is not None:
+                root_addr = "127.0.0.1:%d" % port
+                for rid in sorted(
+                        self.plan.relays,
+                        key=lambda r: -self.plan.relays[r].level):
+                    info = self.plan.relays[rid]
+                    chain = ["127.0.0.1:%d" % relay_ports[a]
+                             for a in self.plan.relay_ancestors(rid)]
+                    chain.append(root_addr)
+                    self.relays[rid] = relay_mod.RelayServer(
+                        rid, chain, port=relay_ports[rid],
+                        liveness_interval_s=liveness_interval_s,
+                        liveness_timeout_s=knobs.liveness_timeout_s,
+                        registration_timeout_s=(
+                            knobs.registration_timeout_s),
+                        depth_below=info.depth_below)
+            for rank in range(1, size):
                 st = _StateStub(rank, size, knobs)
                 st.backend = SimBackend(rank, size, self.exchanger)
                 rt = BackgroundRuntime(st)
@@ -310,6 +355,26 @@ class ChaosWorld:
         socket while the rank itself stays healthy — the reconnecting
         channel must resume the session inside the grace window."""
         self.runtimes[rank].controller.debug_sever()
+
+    # --- relay drill hooks (fanout worlds only) ----------------------
+    def kill_relay(self, rid: int):
+        """Relay process death: every one of its sockets dies at once;
+        its children must re-home through their ancestor chain."""
+        self.relays[rid].debug_kill()
+
+    def wedge_relay(self, rid: int, on: bool = True):
+        """SIGSTOP analog on a relay: forwarding freezes, sockets stay
+        open — only the per-hop liveness deadlines can expose it."""
+        self.relays[rid].debug_wedge(on)
+
+    def sever_relay_uplink(self, rid: int):
+        """Pull the relay's uplink cable: it fail-stops, severing its
+        children (who re-home) — the cheapest interior network cut."""
+        self.relays[rid].debug_sever_parent()
+
+    def subtree_ranks(self, rid: int):
+        info = self.plan.relays[rid]
+        return list(range(info.leaf_lo, info.leaf_hi))
 
     def watch_fatal(self):
         """Register a fatal listener on every runtime; returns
@@ -380,6 +445,12 @@ class ChaosWorld:
             except Exception:
                 pass
         self.runtimes = []
+        for rs in self.relays.values():
+            try:
+                rs.shutdown()
+            except Exception:
+                pass
+        self.relays = {}
         for key, value in self._saved_env.items():
             if value is None:
                 os.environ.pop(key, None)
@@ -1331,7 +1402,8 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
                    hang_timeout_s: float = 20.0,
                    stall_shutdown_s: float = 4.0,
                    detect_budget_s: float = 10.0,
-                   commit_timeout_s: float = 3.0) -> dict:
+                   commit_timeout_s: float = 3.0,
+                   fanout: int = 0) -> dict:
     """The self-healing control plane end to end, with wall-clock
     numbers: ``ranks`` thread-ranks train a deterministic param vector
     over the REAL control plane with liveness + reconnect armed,
@@ -1388,6 +1460,7 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
 
     record = {"kind": "mttr_drill", "fault": fault, "when": when,
               "ranks": ranks, "seed": seed, "victim": victim,
+              "fanout": fanout,
               "liveness_interval_s": liveness_interval_s,
               "steps_before": steps_before, "commit_every": commit_every}
     errors, results_bad, fatal_after_drop = [], [], []
@@ -1396,7 +1469,7 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
         world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
                            exchange_timeout_s=2 * stall_shutdown_s,
                            liveness_interval_s=liveness_interval_s,
-                           reconnect_grace_s=grace)
+                           reconnect_grace_s=grace, fanout=fanout)
         fatal_times = world.watch_fatal()
         coord = LocalCommitCoordinator()
         mgrs = [CheckpointManager(ckpt_dir, rank=r, world_size=ranks,
@@ -1566,7 +1639,7 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
         world2 = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
                             exchange_timeout_s=2 * stall_shutdown_s,
                             liveness_interval_s=liveness_interval_s,
-                            reconnect_grace_s=grace)
+                            reconnect_grace_s=grace, fanout=fanout)
         t_restore = time.monotonic()
         restore_mgr = CheckpointManager(ckpt_dir, rank=0, world_size=1)
         try:
@@ -1684,6 +1757,369 @@ def run_mttr_matrix(ranks: int = 8, seed: int = 0,
     }
 
 
+# ---------------------------------------------------------------------------
+# relay-tree drills: survive interior fan-out loss
+# ---------------------------------------------------------------------------
+
+def run_relay_drill(fault: str = "kill", when: str = "negotiation",
+                    ranks: int = 8, fanout: int = 2, seed: int = 0,
+                    liveness_interval_s: float = 0.3,
+                    warm_steps: int = 3, post_steps: int = 5,
+                    hang_timeout_s: float = 25.0,
+                    stall_shutdown_s: float = 6.0) -> dict:
+    """Kill/wedge/cut an INTERIOR relay while the world is idle /
+    mid-negotiation / mid-replay.  Unlike a dead rank, a dead relay
+    must be *transparent*: every leaf it served re-homes through its
+    ancestor chain (resume rings replay whatever the relay swallowed),
+    so the drill asserts
+
+    * zero hangs and zero fatal unwinds on ANY rank — the world never
+      breaks,
+    * every collective, including those in flight through the dying
+      relay, completes bit-correct,
+    * the whole subtree re-homes (resumed re-home count >= subtree
+      size) within the depth-aware detection bound + grace window.
+    """
+    from horovod_tpu.common import env as _env
+    from horovod_tpu.common import metrics as _hm
+
+    assert fault in ("kill", "wedge", "drop"), fault
+    assert when in ("idle", "negotiation", "replay"), when
+    t0 = time.monotonic()
+    failpoints.reset()
+    grace = 4.0 * liveness_interval_s
+    base_timeout = 2.0 * liveness_interval_s
+    rehomes = _hm.REGISTRY.counter("hvd_relay_rehomes_total")
+
+    def resumed():
+        return rehomes.value(outcome="resumed_parent") + \
+            rehomes.value(outcome="resumed_ancestor")
+
+    world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
+                       exchange_timeout_s=3 * stall_shutdown_s,
+                       liveness_interval_s=liveness_interval_s,
+                       reconnect_grace_s=grace, fanout=fanout)
+    assert world.plan is not None, \
+        "ranks=%d fanout=%d degenerates to a flat star" % (ranks,
+                                                           fanout)
+    victim = 0   # a level-0 relay serving real leaves
+    subtree = world.subtree_ranks(victim)
+    levels = world.plan.levels
+    # Detection: the subtree's leaves notice coordinator silence at
+    # the depth-aware deadline (kill/drop are faster: dead sockets);
+    # re-homing then rides the grace window.
+    rehome_bound_s = _env.depth_aware_liveness_timeout(
+        base_timeout, levels) + grace + 3.0
+    fatal_times = world.watch_fatal()
+    errors, results_bad, hangs = [], [], []
+    record = {"kind": "relay_drill", "fault": fault, "when": when,
+              "ranks": ranks, "fanout": fanout, "seed": seed,
+              "victim_relay": victim, "subtree": subtree,
+              "topology": world.plan.to_meta(),
+              "liveness_interval_s": liveness_interval_s,
+              "rehome_bound_s": round(rehome_bound_s, 2)}
+
+    def step_all(phase: str, steps: int, names_fn, base: int):
+        """Every rank runs `steps` allreduces; returns per-rank sums
+        checked against the closed form."""
+        def loop(rank):
+            for i in range(steps):
+                op = base + i
+                try:
+                    out = world.collective(
+                        rank, "allreduce", names_fn(i),
+                        np.full((65,), _rank_value(rank, op),
+                                np.float32), op, hang_timeout_s)
+                except HangError as e:
+                    hangs.append({"rank": rank, "phase": phase,
+                                  "error": str(e)})
+                    return
+                except Exception as e:
+                    errors.append({"rank": rank, "phase": phase,
+                                   "error": repr(e)[:300]})
+                    return
+                expected = _expected_allreduce((65,), op, ranks)
+                if not np.allclose(out, expected, rtol=1e-5):
+                    results_bad.append({"rank": rank, "phase": phase,
+                                        "op": op})
+                    return
+        ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+              for r in range(ranks)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=steps * 2.0 + 2 * hang_timeout_s)
+            if t.is_alive():
+                hangs.append({"rank": t.name, "phase": phase,
+                              "error": "thread never exited"})
+
+    try:
+        resumed0 = resumed()
+        # Phase A: warm the tree (fixed names; replay may engage).
+        step_all("warm", warm_steps, lambda i: "relay.w%d" % (i % 2),
+                 base=0)
+        # Phase B: the fault lands per `when`.
+        fired = {}
+
+        def fire():
+            fired["t"] = time.monotonic()
+            if fault == "kill":
+                world.kill_relay(victim)
+            elif fault == "wedge":
+                world.wedge_relay(victim)
+            else:
+                world.sever_relay_uplink(victim)
+
+        if when == "idle":
+            fire()
+        else:
+            names = (lambda i: "relay.b%d" % i) if \
+                when == "negotiation" else \
+                (lambda i: "relay.w%d" % (i % 2))
+            bt = threading.Thread(
+                target=step_all,
+                args=("fault", post_steps, names, 100), daemon=True)
+            bt.start()
+            time.sleep(0.08)
+            fire()
+            bt.join(timeout=post_steps * 2.0 + 3 * hang_timeout_s)
+        # Re-home: the whole subtree resumes somewhere else.
+        deadline = fired["t"] + rehome_bound_s
+        while time.monotonic() < deadline and \
+                resumed() - resumed0 < len(subtree):
+            time.sleep(0.02)
+        rehome_s = time.monotonic() - fired["t"]
+        rehomed = resumed() - resumed0
+        # Phase C: verification traffic with FRESH names — forces full
+        # negotiation rounds through every re-homed path.
+        step_all("verify", post_steps,
+                 lambda i: "relay.%s.v%d" % (fault, i), base=1000)
+        record.update({
+            "rehomed": int(rehomed),
+            "rehome_s": round(rehome_s, 3),
+            "fatal_events": sorted(fatal_times),
+            "hangs": hangs, "errors": errors,
+            "results_bad": results_bad,
+            "ok": (not hangs and not errors and not results_bad and
+                   not fatal_times and rehomed >= len(subtree) and
+                   rehome_s <= rehome_bound_s),
+        })
+        return record
+    finally:
+        try:
+            world.close()
+        except Exception:
+            pass
+        record["elapsed_s"] = round(time.monotonic() - t0, 3)
+
+
+def run_relay_matrix(ranks: int = 8, fanout: int = 2, seed: int = 0,
+                     faults=("kill", "wedge", "drop"),
+                     whens=("idle", "negotiation", "replay")) -> dict:
+    """The fault x {relay, leaf} x phase matrix: relay victims ride
+    run_relay_drill (the world must NOT break), leaf victims ride the
+    MTTR drill in a fanout world (the world breaks and recovers, PR 6
+    semantics, now with the fault signal crossing a relay hop)."""
+    t0 = time.monotonic()
+    cells = []
+    for fault in faults:
+        for when in whens:
+            logger.info("relay drill: relay x %s x %s", fault, when)
+            cells.append(run_relay_drill(fault=fault, when=when,
+                                         ranks=ranks, fanout=fanout,
+                                         seed=seed))
+    leaf_faults = {"kill": "kill", "wedge": "wedge",
+                   "drop": "conn_drop"}
+    leaf_whens = {"idle": "idle", "negotiation": "during_negotiation",
+                  "replay": "during_replay"}
+    for fault in faults:
+        for when in whens:
+            logger.info("relay drill: leaf x %s x %s", fault, when)
+            cell = run_mttr_drill(fault=leaf_faults[fault],
+                                  when=leaf_whens[when], ranks=ranks,
+                                  seed=seed, fanout=fanout)
+            cell["victim_kind"] = "leaf"
+            cells.append(cell)
+    return {
+        "kind": "relay_matrix", "ranks": ranks, "fanout": fanout,
+        "seed": seed, "cells": cells,
+        "ok": all(c.get("ok") for c in cells),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# negotiation scale probe: protocol-only latency at 8-256 ranks
+# ---------------------------------------------------------------------------
+
+def run_negotiation_scale_probe(ranks: int, fanout: int,
+                                rounds: int = 6,
+                                payload_elems: int = 65) -> dict:
+    """Full-negotiation round latency with N *lightweight* protocol
+    clients (one socket each — no runtimes, no data plane, no threads
+    per rank), through real relays when fanout > 0.  Two numbers per
+    round:
+
+    * ``wall_ms`` — last uplink sent -> every rank holds its RS frame
+      (end-to-end; in this single-process simulation all relays share
+      one core, so total work is O(ranks) regardless of topology);
+    * ``root_broadcast_ms`` / ``root_sends`` / ``root_frames`` — the
+      rank-0 coordinator's own serialized fan-out cost, the quantity
+      the tree bounds to O(fanout) and the honest sub-linearity
+      witness on a 1-core rig (on a pod, relays run on their own
+      hosts and the root's serialized path IS the latency)."""
+    import struct as _struct
+
+    from horovod_tpu.common import relay as relay_mod
+    from horovod_tpu.common.controller_net import (CoordinatorServer,
+                                                   _recv_frame,
+                                                   _send_frame)
+    from horovod_tpu.common.message import (pack_request_list,
+                                            RequestType)
+
+    t0 = time.monotonic()
+    server = CoordinatorServer(size=ranks, port=0, cache_capacity=0,
+                               stall_warning_time_s=0.0,
+                               fanout=fanout)
+    plan = server._plan
+    relays = {}
+    socks = {}
+    try:
+        root_addr = "127.0.0.1:%d" % server.port
+        if plan is not None:
+            for rid in sorted(plan.relays,
+                              key=lambda r: -plan.relays[r].level):
+                chain = ["127.0.0.1:%d" % relays[a].port
+                         for a in plan.relay_ancestors(rid)]
+                chain.append(root_addr)
+                relays[rid] = relay_mod.RelayServer(
+                    rid, chain, depth_below=plan.relays[rid]
+                    .depth_below)
+        for rank in range(ranks):
+            rid = plan.leaf_parent(rank) if plan is not None else None
+            if rid is None:
+                addr = ("127.0.0.1", server.port)
+            else:
+                addr = ("127.0.0.1", relays[rid].port)
+            s = socket.create_connection(addr, timeout=10.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(30.0)
+            _send_frame(s, b"RQ", _struct.pack("<i", rank))
+            socks[rank] = s
+
+        walls, bcasts, sends, frames = [], [], [], []
+        for rnd in range(rounds):
+            name = "scale.r%d" % rnd
+            payloads = {}
+            for rank in range(ranks):
+                req = Request(
+                    request_rank=rank,
+                    request_type=RequestType.ALLREDUCE,
+                    tensor_name=name,
+                    tensor_shape=(payload_elems,),
+                    tensor_type=dtype_of(np.zeros(1, np.float32)),
+                    reduce_op="Sum")
+                payloads[rank] = pack_request_list([req])
+            b0, s0, f0 = server.bcast_ns, server.bcast_sends, \
+                server.uplink_frames
+            t_start = time.monotonic()
+            for rank in range(ranks):
+                _send_frame(socks[rank], b"RQ", payloads[rank])
+            for rank in range(ranks):
+                while True:
+                    frame = _recv_frame(socks[rank])
+                    if frame is None:
+                        raise RuntimeError(
+                            "rank %d link died mid-round" % rank)
+                    if frame[0] == b"RS":
+                        break
+            walls.append(time.monotonic() - t_start)
+            # Settle: the last client recv can race the coordinator's
+            # own post-broadcast counter update by a few microseconds.
+            time.sleep(0.003)
+            bcasts.append((server.bcast_ns - b0) / 1e6)
+            sends.append(server.bcast_sends - s0)
+            frames.append(server.uplink_frames - f0)
+        walls_ms = sorted(1e3 * w for w in walls)
+        sends.sort()
+        frames.sort()
+        return {
+            "ranks": ranks, "fanout": fanout, "rounds": rounds,
+            "topology": plan.to_meta() if plan is not None
+            else {"flat": True, "root_links": ranks},
+            "wall_ms": {"median": round(walls_ms[len(walls_ms) // 2],
+                                        3),
+                        "max": round(walls_ms[-1], 3)},
+            "root_broadcast_ms": round(
+                sorted(bcasts)[len(bcasts) // 2], 4),
+            "root_sends_per_round": sends[len(sends) // 2],
+            "root_frames_per_round": frames[len(frames) // 2],
+            "elapsed_s": round(time.monotonic() - t0, 2),
+        }
+    finally:
+        for s in socks.values():
+            try:
+                _send_frame(s, b"RQ",
+                            pack_request_list([], shutdown=True))
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        for rs in relays.values():
+            try:
+                rs.shutdown()
+            except Exception:
+                pass
+        server.stop()
+
+
+def run_scale_lane(sizes=(8, 64, 256), fanout: int = 8,
+                   rounds: int = 6) -> dict:
+    """The 8 -> 64 -> 256 negotiation-latency lane (bench.py records
+    it in the BENCH artifact): tree vs flat star at every size, plus
+    the growth ratios the regression gate watches.  Sub-linearity is
+    asserted on the root's serialized fan-out cost (see
+    run_negotiation_scale_probe for why that is the honest metric on
+    a shared-core rig)."""
+    t0 = time.monotonic()
+    out = {"fanout": fanout, "sizes": {}}
+    for n in sizes:
+        eff_fanout = fanout if n - 1 > fanout else 0
+        tree = run_negotiation_scale_probe(n, eff_fanout,
+                                           rounds=rounds)
+        flat = run_negotiation_scale_probe(n, 0, rounds=rounds)
+        out["sizes"][str(n)] = {"tree": tree, "flat": flat}
+    lo, hi = str(min(sizes)), str(max(sizes))
+    rank_growth = max(sizes) / float(min(sizes))
+
+    def growth(metric):
+        a = out["sizes"][lo]["tree"][metric]
+        b = out["sizes"][hi]["tree"][metric]
+        if isinstance(a, dict):
+            a, b = a["median"], b["median"]
+        return round(b / a, 3) if a else None
+
+    root_g = growth("root_broadcast_ms")
+    wall_g = growth("wall_ms")
+    out.update({
+        "rank_growth": rank_growth,
+        "root_broadcast_growth": root_g,
+        "wall_growth": wall_g,
+        # < 1.0 = latency grew slower than the world did.
+        "root_growth_vs_ranks": round(root_g / rank_growth, 3)
+        if root_g else None,
+        "sublinear": bool(root_g is not None and
+                          root_g < rank_growth),
+        "root_sends_tree_vs_flat_at_max": [
+            out["sizes"][hi]["tree"]["root_sends_per_round"],
+            out["sizes"][hi]["flat"]["root_sends_per_round"]],
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    })
+    return out
+
+
 def run_soak(ranks: int = 8, schedules: int = 5, seed: int = 0,
              n_ops: int = 30, hang_timeout_s: float = 30.0,
              stall_shutdown_s: float = 4.0,
@@ -1753,12 +2189,50 @@ def main(argv=None) -> int:
                              "transient-drop x idle/during-replay/"
                              "during-negotiation) instead of the "
                              "fault-schedule soak")
+    parser.add_argument("--relay", action="store_true",
+                        help="run the relay-tree failover matrix "
+                             "(kill/wedge/drop x relay/leaf x "
+                             "idle/negotiation/replay) instead of "
+                             "the fault-schedule soak")
+    parser.add_argument("--relay-scale", action="store_true",
+                        help="run the single 64-rank (256 via "
+                             "HOROVOD_CHAOS_SCALE_RANKS) relay "
+                             "kill-mid-negotiation drill")
+    parser.add_argument("--fanout", type=int, default=None,
+                        help="relay arity (default: 2 for --relay, "
+                             "8 for --relay-scale)")
     parser.add_argument("--out", default=None,
                         help="write the JSON artifact here")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING)
+    if args.relay:
+        report = run_relay_matrix(ranks=args.ranks,
+                                  fanout=args.fanout or 2,
+                                  seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        summary = {k: report[k] for k in ("ranks", "fanout", "ok",
+                                          "elapsed_s")}
+        print("CHAOSJSON " + json.dumps(summary))
+        return 0 if report["ok"] else 1
+    if args.relay_scale:
+        ranks = int(os.environ.get("HOROVOD_CHAOS_SCALE_RANKS",
+                                   "64"))
+        fanout = args.fanout or 8
+        report = run_relay_drill(fault="kill", when="negotiation",
+                                 ranks=ranks, fanout=fanout,
+                                 seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        summary = {k: report.get(k) for k in
+                   ("ranks", "fanout", "rehomed", "rehome_s",
+                    "rehome_bound_s", "ok", "elapsed_s")}
+        print("CHAOSJSON " + json.dumps(summary))
+        return 0 if report["ok"] else 1
     if args.mttr:
         report = run_mttr_matrix(ranks=args.ranks, seed=args.seed)
         if args.out:
