@@ -707,6 +707,19 @@ def bench_recovery(args, smoke: bool) -> dict:
                for fault in ("kill", "wedge")}
     restores = [c["restore_s"] for c in cells
                 if c.get("restore_s") is not None]
+    # Flight-recorder postmortems (tools/blackbox_merge.py): every
+    # kill/wedge cell now carries a causally merged detect→promote→
+    # restore→resume breakdown derived from the per-rank event dumps —
+    # the artifact embeds the per-phase medians instead of only the
+    # coarse wall-clock timers above.
+    pm_spans = [c["postmortem"]["spans"] for c in cells
+                if (c.get("postmortem") or {}).get("spans")]
+    breakdown_ms = {
+        phase: round(1e3 * _percentile(
+            [s[phase] for s in pm_spans if phase in s], 50), 1)
+        for phase in ("detect", "promote", "restore", "resume",
+                      "total")
+    } if pm_spans else None
     from horovod_tpu.common import metrics as _hm
     snap = _hm.snapshot()
     return {
@@ -714,6 +727,10 @@ def bench_recovery(args, smoke: bool) -> dict:
         "liveness_interval_s": interval,
         "cells": len(cells) + 1,
         "cells_ok": all(c.get("ok") for c in cells) and drop.get("ok"),
+        "postmortem_breakdown_ms": breakdown_ms,
+        "postmortem_named_victim_all": all(
+            (c.get("postmortem") or {}).get("named_victim")
+            for c in cells),
         "mttr_ms": {
             "p50": round(1e3 * _percentile(mttrs, 50), 1)
             if mttrs else None,
@@ -756,6 +773,112 @@ def bench_recovery(args, smoke: bool) -> dict:
                 "counters", {}).get("hvd_liveness_timeouts_total"),
         },
     }
+
+
+def bench_blackbox(args, smoke: bool) -> dict:
+    """Flight-recorder cost, measured: the disabled hot-path guard
+    (ONE module-attribute check — the number the perf-pin test bounds)
+    and the enabled per-event record cost (tuple build + bounded
+    deque.append), plus a dump+merge wall time for a full ring so the
+    postmortem path itself has a tracked number."""
+    import shutil
+    import tempfile
+    import timeit
+
+    from horovod_tpu.common import flight_recorder as fr
+
+    fr.reset()
+    n = 200_000
+    # The exact site shape: short-circuit on the module attribute, so
+    # record() is never entered while disabled.
+    disabled_ns = timeit.timeit(
+        "fr.ENABLED and fr.record(fr.SUBMIT, name='bench.t')",
+        globals={"fr": fr}, number=n) / n * 1e9
+    fr.configure(capacity=8192, enabled=True)
+    enabled_ns = timeit.timeit(
+        "fr.record(fr.SUBMIT, rank=0, name='bench.t', type='ALLREDUCE')",
+        globals={"fr": fr}, number=n) / n * 1e9
+    # Dump + merge a full ring: the cost of actually using the black
+    # box after a failure (never on the hot path).
+    bb_dir = tempfile.mkdtemp(prefix="hvd-bb-bench-")
+    t0 = time.perf_counter()
+    try:
+        fr.record(fr.FRAME_TX, rank=1, role="worker", frame="HB",
+                  nbytes=0)
+        paths = fr.dump("bench", directory=bb_dir)
+        dump_ms = (time.perf_counter() - t0) * 1e3
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import blackbox_merge
+        t1 = time.perf_counter()
+        trace, _verdict = blackbox_merge.merge(bb_dir)
+        merge_ms = (time.perf_counter() - t1) * 1e3
+    finally:
+        fr.reset()
+        shutil.rmtree(bb_dir, ignore_errors=True)
+    return {
+        "disabled_ns_per_check": round(disabled_ns, 1),
+        "enabled_ns_per_event": round(enabled_ns, 1),
+        "ring_capacity": 8192,
+        "dumps": len(paths),
+        "dump_ms": round(dump_ms, 2),
+        "merge_full_ring_ms": round(merge_ms, 2),
+        "merged_trace_events": len(trace),
+    }
+
+
+def _prior_bench_value(repo_dir: str, pattern: str):
+    """Newest prior BENCH_r*.json whose raw text matches ``pattern``
+    (group 1 = a positive number): the shared scan every *_vs_prior
+    regression check performs.  Returns (value, basename) or None."""
+    import glob
+    import re
+    for path in reversed(sorted(glob.glob(
+            os.path.join(repo_dir, "BENCH_r*.json")))):
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        m = re.search(pattern, raw, re.S)
+        if m and float(m.group(1)) > 0:
+            return float(m.group(1)), os.path.basename(path)
+    return None
+
+
+def check_blackbox_regression(out: dict, repo_dir: str):
+    """The recorder's costs are regression-warned like the smoke
+    headline: the disabled guard must stay in attribute-check
+    territory, and the enabled per-event cost must not grow past the
+    noise band vs the prior round's artifact."""
+    cur = out.get("blackbox") or {}
+    if not cur or "error" in cur:
+        return
+    if cur.get("disabled_ns_per_check", 0) > 1000:
+        print("WARNING: flight-recorder disabled guard costs %.0f ns "
+              "(>1us): no longer a bare attribute check"
+              % cur["disabled_ns_per_check"], file=sys.stderr)
+    prior = _prior_bench_value(
+        repo_dir, r'"blackbox":\s*\{[^}]*?"enabled_ns_per_event":\s*'
+                  r'(-?[0-9.]+)')
+    if prior is None:
+        return  # first round with a blackbox lane
+    prior_ns, prior_source = prior
+    tol_pct = 100.0  # ns-scale timeit on a shared CPU: wide band
+    delta_pct = (cur["enabled_ns_per_event"] - prior_ns) \
+        / prior_ns * 100.0
+    cur["blackbox_vs_prior"] = {
+        "prior_enabled_ns": prior_ns,
+        "prior_source": prior_source,
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": tol_pct,
+        "regressed": delta_pct > tol_pct,
+    }
+    if cur["blackbox_vs_prior"]["regressed"]:
+        print("WARNING: flight-recorder enabled cost regressed "
+              "%.1f%% vs %s (%.0f ns -> %.0f ns)"
+              % (delta_pct, prior_source, prior_ns,
+                 cur["enabled_ns_per_event"]), file=sys.stderr)
 
 
 def check_recovery_regression(out: dict, repo_dir: str):
@@ -1730,7 +1853,8 @@ def main():
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
                         "collectives", "checkpoint", "scale",
-                        "recovery", "dlrm", "coordscale"],
+                        "recovery", "dlrm", "coordscale",
+                        "blackbox"],
                    default=None)
     args = p.parse_args()
 
@@ -1785,7 +1909,7 @@ def main():
     run = {args.only} if args.only else {"resnet", "bert", "keras",
                                      "collectives", "checkpoint",
                                      "scale", "recovery", "dlrm",
-                                     "coordscale"}
+                                     "coordscale", "blackbox"}
 
     resnet = {}
     if "resnet" in run:
@@ -1861,6 +1985,13 @@ def main():
         except Exception as e:
             out["coord_scale"] = {"error": repr(e)[:300]}
         check_coord_scale_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "blackbox" in run:
+        try:
+            out["blackbox"] = bench_blackbox(args, args.smoke)
+        except Exception as e:
+            out["blackbox"] = {"error": repr(e)[:300]}
+        check_blackbox_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:

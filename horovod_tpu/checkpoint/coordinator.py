@@ -28,6 +28,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..common import failpoints as _fp
+from ..common import flight_recorder as _fr
 from ..common import metrics
 
 logger = logging.getLogger("horovod_tpu.checkpoint")
@@ -237,6 +238,13 @@ class KVCommitCoordinator(CommitCoordinator):
                            2.0) if consecutive_errors else self._poll)
 
     def mark_committed(self, step: int):
+        if _fr.ENABLED:
+            # rank 0 explicitly: mark_committed is the commit
+            # arbiter's action by protocol (manager._write_one calls
+            # it on rank 0 only), and the process-global default tag
+            # is whatever rank last init'd in the in-process harness.
+            _fr.record(_fr.CKPT, rank=0, phase="manifest_publish",
+                       step=step)
         try:
             self._client.put(SCOPE, KEY_LATEST, str(step).encode())
         except OSError:

@@ -52,6 +52,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..common import env as _env
 from ..common import failpoints as _fp
+from ..common import flight_recorder as _fr
 from ..common import metrics
 from . import delta as _delta
 from . import manifest as _mf
@@ -335,6 +336,10 @@ class CheckpointManager:
                  "items": sorted(own_items)}
         if pending.delta_of is not None:
             entry["delta_of"] = pending.delta_of
+        if _fr.ENABLED:
+            _fr.record(_fr.CKPT, rank=self.rank, phase="prepare",
+                       step=step, nbytes=nbytes,
+                       delta_of=pending.delta_of)
         self.coordinator.prepare(step, self.rank, entry)
 
         if self.rank != 0:
@@ -396,6 +401,10 @@ class CheckpointManager:
                            shards=marks, layout=layout, meta=meta)
         _mf.write_manifest(sdir, man, rank=self.rank)
         self.coordinator.mark_committed(step)
+        if _fr.ENABLED:
+            _fr.record(_fr.CKPT, rank=self.rank, phase="commit",
+                       step=step, outcome="committed",
+                       chain_len=meta.get("chain_len", 0))
         _DELTA_CHAIN.set(float(meta.get("chain_len", 0)))
         _SAVE_SECONDS.observe(time.perf_counter() - t_c, phase="commit")
         _SAVE_SECONDS.observe(time.perf_counter() - t_start,
@@ -515,6 +524,10 @@ class CheckpointManager:
         _RESTORE_SECONDS.observe(time.perf_counter() - t0,
                                  phase="total")
         _RESTORE_CHAIN_LINKS.observe(float(len(chain)))
+        if _fr.ENABLED:
+            _fr.record(_fr.CKPT, rank=self.rank, phase="restore",
+                       step=step, chain=len(chain),
+                       seconds=round(time.perf_counter() - t0, 4))
         return items
 
     def restore_latest(self) -> Tuple[int, Dict[str, object]]:

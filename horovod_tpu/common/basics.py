@@ -253,6 +253,14 @@ def init(comm=None, process_sets=None):
         from . import failpoints
         failpoints.set_rank(state.rank_info.rank)
 
+        # Black-box flight recorder: rank-tag events recorded from here
+        # on, and install the SIGUSR2 dump hook (no-op off the main
+        # thread or when the recorder is disarmed).
+        from . import flight_recorder
+        flight_recorder.set_rank(state.rank_info.rank)
+        if flight_recorder.ENABLED:
+            flight_recorder.install_signal_handler()
+
         from ..ops.backend import create_backend
         state.backend = create_backend(state)
 

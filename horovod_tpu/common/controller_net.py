@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from . import env as env_mod
 from . import failpoints as _fp
+from . import flight_recorder as _fr
 from . import metrics
 from . import relay as relay_mod
 from .controller import Controller, MessageTable, construct_response
@@ -470,6 +471,10 @@ class CoordinatorServer:
         conn.settimeout(None)
         logger.info("relay %d link registered (depth_below=%d)", rid,
                     self._relay_depth[rid])
+        if _fr.ENABLED:
+            _fr.record(_fr.RELAY_ATTACH, rank=0, role="coord",
+                       relay=rid, depth=self._relay_depth[rid],
+                       cyc=gen)
         self._mux.add(_LinkToken("relay", rid, gen), conn)
 
     def _install_conn_locked(self, rank: int, conn: socket.socket) -> int:
@@ -509,6 +514,9 @@ class CoordinatorServer:
 
     def _register_fresh(self, rank: int, sess: dict,
                         conn: socket.socket):
+        if _fr.ENABLED:
+            _fr.record(_fr.REGISTER, rank=0, role="coord", peer=rank,
+                       sess=(sess.get("session") or "")[:8])
         with self._lock:
             gen = self._install_conn_locked(rank, conn)
             self._sessions[rank] = sess.get("session", "")
@@ -588,10 +596,17 @@ class CoordinatorServer:
         self._last_heard[rank] = time.monotonic()
         if magic in _OOS_UP:
             _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
+            if _fr.ENABLED and magic == _MAGIC_HB:
+                _fr.record(_fr.HB_RX, rank=0, role="coord", peer=rank)
             if magic == _MAGIC_METRICS_REP:
                 self._handle_metrics_snapshot(rank, payload)
             return True
         self.uplink_frames += 1
+        if _fr.ENABLED:
+            _fr.record(_fr.FRAME_RX, rank=0, role="coord", peer=rank,
+                       frame=magic.decode("ascii", "replace"),
+                       nbytes=len(payload),
+                       seq=self._in_count.get(rank, 0) + 1, cyc=gen)
         if _fp.ENABLED:
             try:
                 if _fp.maybe_fail("coord.frame_recv",
@@ -634,6 +649,8 @@ class CoordinatorServer:
         self._last_heard[("relay", rid)] = time.monotonic()
         if magic == _MAGIC_HB:
             _FRAMES_RECV.inc(1, kind="HB")
+            if _fr.ENABLED:
+                _fr.record(_fr.HB_RX, rank=0, role="coord", relay=rid)
             return True
         if magic == relay_mod.MAGIC_METRICS_AGG:
             self._handle_metrics_aggregate(rid, payload)
@@ -683,6 +700,13 @@ class CoordinatorServer:
             if magic == _MAGIC_METRICS_REP:
                 self._handle_metrics_snapshot(origin, payload)
             return
+        if _fr.ENABLED:
+            _fr.record(_fr.FRAME_RX, rank=0, role="coord",
+                       peer=origin, via=rid,
+                       frame=magic.decode("ascii", "replace"),
+                       nbytes=len(payload),
+                       seq=self._in_count.get(origin, 0) + 1,
+                       cyc=epoch)
         if _fp.ENABLED:
             try:
                 if _fp.maybe_fail("coord.frame_recv",
@@ -804,6 +828,10 @@ class CoordinatorServer:
                     (sess.get("session") or "?")[:8], recv_count,
                     out_seq)
                 _RECONNECTS.inc(1, outcome="refused")
+                if _fr.ENABLED:
+                    _fr.record(_fr.RESUME, rank=0, role="coord",
+                               peer=rank, outcome="refused", via=rid,
+                               seq=recv_count)
                 if rconn is not None:
                     try:
                         _send_frame(rconn, relay_mod.MAGIC_RELAY_DOWN,
@@ -875,6 +903,10 @@ class CoordinatorServer:
                     "downlink frames)", rank, rid,
                     self._out_seq.get(rank, 0) - recv_count)
         _RECONNECTS.inc(1, outcome="resumed")
+        if _fr.ENABLED:
+            _fr.record(_fr.RESUME, rank=0, role="coord", peer=rank,
+                       outcome="resumed", via=rid, cyc=epoch,
+                       replayed=self._out_seq.get(rank, 0) - recv_count)
 
     def _send_targeted_locked(self, rank: int, magic: bytes,
                               payload: bytes, log: bool = True):
@@ -949,6 +981,10 @@ class CoordinatorServer:
                 pass
         if stopped:
             return
+        if _fr.ENABLED:
+            _fr.record(_fr.RELAY_DOWN, rank=0, role="coord",
+                       relay=rid, reason=reason or "connection lost",
+                       subtree=list(subtree), cyc=gen)
         if subtree:
             logger.warning(
                 "relay %d link down (%s): %s", rid,
@@ -986,6 +1022,10 @@ class CoordinatorServer:
         except (ValueError, TypeError, UnicodeDecodeError):
             logger.warning("undecodable RL notice from relay %d", rid)
             return
+        if _fr.ENABLED:
+            _fr.record(_fr.RELAY_LOST, rank=0, role="coord", relay=rid,
+                       lost_kind=kind, reason=reason,
+                       ranks=[r for r, _ in entries])
         promote = []
         now = time.monotonic()
         with self._lock:
@@ -1072,6 +1112,10 @@ class CoordinatorServer:
                     (sess.get("session") or "?")[:8], recv_count,
                     out_seq, self.reconnect_grace_s)
                 _RECONNECTS.inc(1, outcome="refused")
+                if _fr.ENABLED:
+                    _fr.record(_fr.RESUME, rank=0, role="coord",
+                               peer=rank, outcome="refused",
+                               seq=recv_count)
                 try:
                     _send_frame(conn, _MAGIC_WELCOME,
                                 json.dumps({"resume": False}).encode())
@@ -1147,6 +1191,10 @@ class CoordinatorServer:
         logger.info("rank %d control channel resumed (replayed %d "
                     "downlink frames)", rank, out_seq - recv_count)
         _RECONNECTS.inc(1, outcome="resumed")
+        if _fr.ENABLED:
+            _fr.record(_fr.RESUME, rank=0, role="coord", peer=rank,
+                       outcome="resumed", cyc=gen,
+                       replayed=out_seq - recv_count)
         self._serve_link(rank, conn, gen)
 
     def _spawn_rank_loop(self, rank: int, conn: socket.socket,
@@ -1212,10 +1260,20 @@ class CoordinatorServer:
                     # cursor (symmetric with the worker's up-log).
                     _FRAMES_RECV.inc(1, kind=magic.decode(
                         "ascii", "replace"))
+                    if _fr.ENABLED and magic == _MAGIC_HB:
+                        _fr.record(_fr.HB_RX, rank=0, role="coord",
+                                   peer=rank)
                     if magic == _MAGIC_METRICS_REP:
                         self._handle_metrics_snapshot(rank, payload)
                     continue
                 self.uplink_frames += 1
+                if _fr.ENABLED:
+                    _fr.record(_fr.FRAME_RX, rank=0, role="coord",
+                               peer=rank,
+                               frame=magic.decode("ascii", "replace"),
+                               nbytes=len(payload),
+                               seq=self._in_count.get(rank, 0) + 1,
+                               cyc=gen)
                 # Failpoint site: uplink frame arrival on the
                 # coordinator.  drop() discards the frame (the sender's
                 # tensor goes incomplete — the stall machinery must
@@ -1319,7 +1377,16 @@ class CoordinatorServer:
                 conn.close()  # unblocks a rank loop stuck in recv
             except OSError:
                 pass
+        if _fr.ENABLED:
+            _fr.record(_fr.PROMOTE, rank=0, role="coord", peer=rank,
+                       clean=clean, reason=reason or "connection lost")
         self._on_rank_lost(rank, clean, reason)
+        if _fr.ENABLED and not clean:
+            # Dump AFTER the dead-rank notice fan-out: the ring keeps
+            # recording, so deferring costs no evidence, while a file
+            # write before _on_rank_lost would sit inside the very
+            # detect window the MTTR drills bound.
+            _fr.trigger_dump("promotion")
         return True
 
     def _count_departed(self, rank: int):
@@ -1339,6 +1406,9 @@ class CoordinatorServer:
             return
         self._conns.pop(rank, None)
         self._limbo[rank] = time.monotonic()
+        if _fr.ENABLED:
+            _fr.record(_fr.LIMBO, rank=0, role="coord", peer=rank,
+                       grace_s=self.reconnect_grace_s)
         logger.info("rank %d control link dropped; holding in limbo "
                     "for %.1fs grace", rank, self.reconnect_grace_s)
 
@@ -1998,6 +2068,10 @@ class CoordinatorServer:
                 self._conns.pop(r, None)
         self.bcast_ns += time.perf_counter_ns() - t0
         self.bcast_sends += sent
+        if _fr.ENABLED:
+            _fr.record(_fr.FRAME_TX, rank=0, role="coord",
+                       frame=magic.decode("ascii", "replace"),
+                       nbytes=len(payload), fanout=sent)
         if sent:
             # Coordinator fan-out is the dominant control-plane send
             # volume on rank 0 — account it next to the worker-side
@@ -2112,16 +2186,29 @@ class CoordinatorServer:
                 if age - last < self._stall_warning_s and last > 0:
                     continue
                 self._stall_logged[key] = age
+                # Flight-recorder attribution: the warning names what
+                # the implicated tensor last DID (frame/replay/submit
+                # events), not just which ranks are missing.
+                recent = _fr.recent_for_tensors([name]) \
+                    if _fr.ENABLED else []
                 logger.warning(
                     "STALL: tensor %s — ranks %s submitted, ranks %s "
                     "have not, for %.0fs. One or more ranks may be "
-                    "running a different graph or have hung.",
-                    name, submitted, missing, age)
+                    "running a different graph or have hung.%s",
+                    name, submitted, missing, age,
+                    (" Last recorder events: %s" % recent)
+                    if recent else "")
+                if _fr.ENABLED:
+                    _fr.record(_fr.STALL, rank=0, role="coord",
+                               tensor=name, submitted=submitted,
+                               missing=missing, age_s=round(age, 3))
                 if 0 < self._stall_shutdown_s <= age:
                     logger.error(
                         "stalled tensor %s exceeded shutdown threshold "
                         "(%.0fs); failing the collective", name,
                         self._stall_shutdown_s)
+                    if _fr.ENABLED:
+                        _fr.trigger_dump("stall_shutdown")
                     with self._lock:
                         msgs = self._table.pop(key)
                         # Barriers stall too (tracked outside the
@@ -2770,10 +2857,20 @@ class NetworkController(Controller):
                     ("ancestor %d" % target_idx),
                     self._up_count - acked)
                 _RECONNECTS.inc(1, outcome="resumed")
+                if _fr.ENABLED:
+                    _fr.record(_fr.RESUME, rank=self.rank,
+                               role="worker", outcome="resumed",
+                               hop=target_idx, attempts=attempt,
+                               replayed=self._up_count - acked,
+                               sess=self._session_id[:8])
                 if len(chain) > 1:
                     relay_mod._REHOMES.inc(
                         1, outcome="resumed_parent" if target_idx == 0
                         else "resumed_ancestor")
+                    if _fr.ENABLED:
+                        _fr.record(_fr.REHOME, rank=self.rank,
+                                   role="worker", hop=target_idx,
+                                   outcome="resumed")
                 return True
             except (OSError, ValueError):
                 try:
@@ -2785,6 +2882,10 @@ class NetworkController(Controller):
             logger.warning("control channel could not be re-established "
                            "within the %.1fs grace window", self._grace_s)
             _RECONNECTS.inc(1, outcome="failed")
+            if _fr.ENABLED:
+                _fr.record(_fr.RESUME, rank=self.rank, role="worker",
+                           outcome="failed", attempts=attempt,
+                           sess=self._session_id[:8])
             if len(chain) > 1:
                 relay_mod._REHOMES.inc(1, outcome="failed")
         return False
@@ -2800,6 +2901,7 @@ class NetworkController(Controller):
         which model exactly the silent failures liveness exists to
         catch."""
         period = max(self._liveness_interval_s / 2.0, 0.05)
+        suppressed = False  # flight-recorder state flip, not per-tick
         while not self._hb_stop.wait(period):
             if self._closing:
                 return
@@ -2826,7 +2928,16 @@ class NetworkController(Controller):
                 continue
             if time.monotonic() - self._last_uplink_t < \
                     self._liveness_interval_s:
-                continue  # real traffic is flowing; HB suppressed
+                # Real traffic is flowing; HB suppressed.  Record the
+                # state FLIP only (never per tick): a postmortem can
+                # tell "quiet because piggybacked" from "quiet because
+                # dead" without the ring filling with suppressions.
+                if _fr.ENABLED and not suppressed:
+                    _fr.record(_fr.HB_TX, rank=self.rank,
+                               role="worker", suppressed=True)
+                suppressed = True
+                continue
+            suppressed = False
             if _fp.ENABLED and _fp.maybe_fail(
                     "net.heartbeat_drop", rank=self.rank) == "drop":
                 continue
@@ -2874,6 +2985,11 @@ class NetworkController(Controller):
 
     def _set_broken(self, err):
         self._broken_err = err
+        if _fr.ENABLED:
+            _fr.record(_fr.FATAL, rank=self.rank, role="worker",
+                       error=str(err)[:200],
+                       sess=self._session_id[:8])
+            _fr.trigger_dump("fatal")
         if self._replay_observer is not None:
             self._replay_observer.on_broken()
         cb = getattr(self, "_on_broken", None)
@@ -2950,6 +3066,9 @@ class NetworkController(Controller):
                 continue  # handshake-only frame; not part of the stream
             if magic == _MAGIC_HB:
                 _FRAMES_RECV.inc(1, kind="HB")
+                if _fr.ENABLED:
+                    _fr.record(_fr.HB_RX, rank=self.rank,
+                               role="worker")
                 continue  # out-of-stream liveness signal
             if magic == _MAGIC_METRICS_REQ:
                 # Out-of-stream metrics poll: absolute snapshots need
@@ -2979,6 +3098,12 @@ class NetworkController(Controller):
             self.stats["bytes_recv"] += len(payload) + 6
             _BYTES_RECV.inc(len(payload) + 6)
             _FRAMES_RECV.inc(1, kind=magic.decode("ascii", "replace"))
+            if _fr.ENABLED:
+                _fr.record(_fr.FRAME_RX, rank=self.rank, role="worker",
+                           frame=magic.decode("ascii", "replace"),
+                           nbytes=len(payload) + 6,
+                           seq=self._recv_count,
+                           sess=self._session_id[:8])
             if magic == _MAGIC_CACHE:
                 self.stats["cb_frames"] += 1
                 batches = unpack_bit_batches(payload)
@@ -3053,6 +3178,11 @@ class NetworkController(Controller):
         self.stats["bytes_sent"] += len(payload) + 6
         _FRAMES_SENT.inc(1, kind=kind)
         _BYTES_SENT.inc(len(payload) + 6)
+        if _fr.ENABLED:
+            _fr.record(_fr.FRAME_TX, rank=self.rank, role="worker",
+                       frame=kind, nbytes=len(payload) + 6,
+                       seq=self._up_count if magic not in _OOS_UP
+                       else None, sess=self._session_id[:8])
 
     def _uplink_send_selfheal(self, magic: bytes, payload: bytes):
         """Uplink send with the self-healing channel on: stamp the

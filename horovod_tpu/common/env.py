@@ -147,6 +147,20 @@ def start_timeout(default: float = None) -> float:
 
 
 # --- observability --------------------------------------------------------
+# Black-box flight recorder (common/flight_recorder.py): a bounded
+# in-memory ring of typed control-plane events, dumped as per-rank
+# JSON on failure triggers (lost-rank promotion, stall shutdown, fatal
+# unwind, SIGUSR2) and merged into one causal chrome-trace +
+# machine-readable verdict by tools/blackbox_merge.py.
+# HOROVOD_BLACKBOX=1 arms the ring (extract via SIGUSR2 or the
+# /blackbox HTTP handler); HOROVOD_BLACKBOX_DIR=/path also enables the
+# automatic failure-trigger dumps; HOROVOD_BLACKBOX_EVENTS bounds the
+# ring (default 8192 events).  Disabled cost on the submit/frame hot
+# paths is ONE attribute check (the failpoints precedent, pinned by
+# tests/test_flight_recorder.py).
+HOROVOD_BLACKBOX = "HOROVOD_BLACKBOX"
+HOROVOD_BLACKBOX_DIR = "HOROVOD_BLACKBOX_DIR"
+HOROVOD_BLACKBOX_EVENTS = "HOROVOD_BLACKBOX_EVENTS"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 # Opt-in Prometheus-text /metrics endpoint: set to a port (0 = pick an
 # ephemeral one); unset = no endpoint.  Each rank binds

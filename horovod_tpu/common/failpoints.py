@@ -70,6 +70,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from . import flight_recorder as _fr
 from . import metrics
 
 logger = logging.getLogger("horovod_tpu.failpoints")
@@ -338,6 +339,11 @@ def maybe_fail(site: str, rank: Optional[int] = None,
     rule, outcome, fresh = fired
     if fresh:
         _TRIGGERS.inc(1, site=site, action=rule.action)
+        if _fr.ENABLED:
+            # The chaos schedule in causal position: a postmortem can
+            # show the injected fault BETWEEN the frames it perturbed.
+            _fr.record(_fr.FAILPOINT, rank=rank, site=site,
+                       action=rule.action)
         logger.debug("failpoint %s: %s fired (trigger #%d)", site,
                      rule.action, rule._triggers)
     if outcome == "delay":

@@ -31,6 +31,7 @@ Three read paths:
 
 import bisect
 import functools
+import json
 import logging
 import threading
 import time
@@ -462,8 +463,23 @@ class MetricsServer:
             def do_GET(self):
                 if not self._authorized():
                     return
-                path = self.path.split("?", 1)[0]
-                if path.rstrip("/") != "/metrics":
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/blackbox":
+                    # Live black-box extraction: the flight recorder's
+                    # ring as JSON, behind the SAME job-secret HMAC as
+                    # /metrics (a postmortem dump is a traffic log —
+                    # never an unauthenticated sidechannel).
+                    from . import flight_recorder
+                    body = json.dumps(flight_recorder.dump_dict(
+                        reason="http")).encode()
+                    self.send_response(OK)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path != "/metrics":
                     self.send_response(NOT_FOUND)
                     self.send_header("Content-Length", "0")
                     self.end_headers()

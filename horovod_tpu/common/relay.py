@@ -48,6 +48,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from . import env as env_mod
+from . import flight_recorder as _fr
 from . import metrics
 
 logger = logging.getLogger("horovod_tpu.relay")
@@ -661,6 +662,12 @@ class RelayServer:
                         time.sleep(0.02)
                 magic, payload = frame
                 _RELAY_FRAMES.inc(1, dir="down")
+                if _fr.ENABLED and magic == b"HB":
+                    # Downlink HB arrival: one half of the HB round
+                    # trip blackbox_merge aligns this relay's clock by.
+                    _fr.record(_fr.HB_RX,
+                               rank="relay%d" % self.relay_id,
+                               role="relay")
                 if magic == MAGIC_RELAY_DOWN:
                     self._route_down(payload)
                     continue
@@ -758,6 +765,13 @@ class RelayServer:
                 self._route[rank] = token
                 self._last_heard[token] = time.monotonic()
                 self._schedule_child_locked(token)
+            if _fr.ENABLED:
+                # Child attach + epoch bump: a postmortem can prove
+                # which connection epoch a frame in flight belonged to.
+                _fr.record(_fr.RELAY_ATTACH,
+                           rank="relay%d" % self.relay_id,
+                           role="relay", peer=rank, cyc=epoch,
+                           superseded=old is not None)
             if old is not None and old.kind == "leaf":
                 # Supersede only a stale connection of the SAME leaf.
                 # A relay-kind route token means the rank used to be
@@ -806,6 +820,10 @@ class RelayServer:
                 self._enqueue_raw(magic, payload)
                 return True
             if magic == b"HB":
+                if _fr.ENABLED:
+                    _fr.record(_fr.HB_RX,
+                               rank="relay%d" % self.relay_id,
+                               role="relay", relay=token.ident)
                 return True   # sub-relay liveness only
             if magic in (MAGIC_METRICS_AGG,):
                 self._note_metrics(token, payload)
@@ -819,6 +837,9 @@ class RelayServer:
             return True
         # leaf child
         if magic == b"HB":
+            if _fr.ENABLED:
+                _fr.record(_fr.HB_RX, rank="relay%d" % self.relay_id,
+                           role="relay", peer=token.ident)
             return True    # consumed: one relay HB stands in for all
         if magic == b"MR":
             self._note_metrics(token, payload)
@@ -837,6 +858,16 @@ class RelayServer:
             for r, _ in lost:
                 if self._route.get(r) is token:
                     self._route.pop(r, None)
+        if _fr.ENABLED and not self._stop.is_set() and \
+                token.kind == "relay":
+            # An interior sub-relay's link died: this parent is the
+            # only witness that can NAME it — the root's RL notice
+            # carries the reporter's id, not the dead hop's.
+            _fr.record(_fr.RELAY_DOWN,
+                       rank="relay%d" % self.relay_id, role="relay",
+                       relay=token.ident,
+                       reason="child relay link closed at relay %d"
+                              % self.relay_id)
         if self._stop.is_set() or not lost:
             return
         self._report_lost(lost, "disconnect",
@@ -856,6 +887,11 @@ class RelayServer:
 
     def _report_lost(self, ranks: List[tuple], kind: str, reason: str):
         _CHILD_LOST.inc(len(ranks), kind=kind)
+        if _fr.ENABLED:
+            _fr.record(_fr.RELAY_LOST,
+                       rank="relay%d" % self.relay_id, role="relay",
+                       lost_kind=kind, reason=reason,
+                       ranks=[r for r, _ in ranks])
         self._enqueue_raw(MAGIC_RELAY_LOST, json.dumps(
             {"ranks": ranks, "kind": kind, "reason": reason}).encode())
 
@@ -928,6 +964,12 @@ class RelayServer:
                     with self._send_lock:
                         self._last_uplink_t = now
                         send_frame(self._parent, b"HB", b"")
+                    if _fr.ENABLED:
+                        # Uplink HB departure: the other half of the
+                        # clock-alignment round trip.
+                        _fr.record(_fr.FRAME_TX,
+                                   rank="relay%d" % self.relay_id,
+                                   role="relay", frame="HB", nbytes=6)
                 except OSError:
                     self.shutdown()
                     return
@@ -940,6 +982,16 @@ class RelayServer:
                     "relay %d: child %r silent past %.1fs; reporting "
                     "lost", self.relay_id, token,
                     self._child_deadline(token))
+                if _fr.ENABLED and token.kind == "relay":
+                    # A WEDGED sub-relay never says its own last word;
+                    # the per-hop deadline here is the only evidence
+                    # that names it.
+                    _fr.record(_fr.RELAY_DOWN,
+                               rank="relay%d" % self.relay_id,
+                               role="relay", relay=token.ident,
+                               reason="silent past the per-hop "
+                                      "deadline at relay %d"
+                                      % self.relay_id)
                 if ranks:
                     self._report_lost(
                         ranks, "silent",
@@ -997,6 +1049,12 @@ class RelayServer:
         if self._stop.is_set():
             return
         self._stop.set()
+        if _fr.ENABLED:
+            # Fail-stop: the relay's own last word in a postmortem.
+            _fr.record(_fr.RELAY_DOWN,
+                       rank="relay%d" % self.relay_id, role="relay",
+                       relay=self.relay_id,
+                       reason="fail-stop shutdown")
         self._up_ev.set()
         for s in (self._srv, self._parent):
             try:

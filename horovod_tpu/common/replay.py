@@ -90,6 +90,7 @@ import threading
 from typing import List, Optional, Tuple
 
 from . import failpoints as _fp
+from . import flight_recorder as _fr
 from . import metrics
 from .message import Request, RequestType, Response, ResponseType
 from .response_cache import request_signature
@@ -492,6 +493,10 @@ class SteadyStateReplay:
         self._batch_reqs = []
         self.active = True
         _ENTRIES.inc()
+        if _fr.ENABLED:
+            _fr.record(_fr.REPLAY,
+                       rank=self.runtime.state.rank_info.rank,
+                       phase="enter", batches=len(schedule))
         if self.runtime.timeline:
             self.runtime.timeline.instant("REPLAY_ENTER")
         logger.debug("steady-state replay engaged: %d batches, %d "
@@ -504,6 +509,10 @@ class SteadyStateReplay:
             return
         self.active = False
         _EXITS.inc(1, reason=reason)
+        if _fr.ENABLED:
+            _fr.record(_fr.REPLAY,
+                       rank=self.runtime.state.rank_info.rank,
+                       phase="exit", reason=reason)
         if self.runtime.timeline:
             self.runtime.timeline.instant("REPLAY_EXIT_" + reason)
         logger.debug("steady-state replay exited: %s", reason)

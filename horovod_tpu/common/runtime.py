@@ -23,6 +23,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import failpoints as _fp
+from . import flight_recorder as _fr
 from . import metrics
 from . import timeline as tl
 from .controller import LoopbackController
@@ -162,6 +163,13 @@ class BackgroundRuntime:
             # dies mid-step (the chaos harness crashes ranks here).
             _fp.maybe_fail("runtime.submit",
                            rank=self.state.rank_info.rank)
+        if _fr.ENABLED:
+            # Flight-recorder site (the per-collective record the NCCL
+            # flight recorder keeps): disabled cost is this ONE
+            # attribute check, pinned by tests/test_flight_recorder.py.
+            _fr.record(_fr.SUBMIT, rank=self.state.rank_info.rank,
+                       name=request.tensor_name,
+                       type=request.request_type.name)
         entry.callback = _latency_wrapped(entry.callback)
         nelem = 1
         for d in request.tensor_shape:
@@ -349,6 +357,10 @@ class BackgroundRuntime:
             return
         self._fatal_fired = True
         self._error = err
+        if _fr.ENABLED:
+            _fr.record(_fr.FATAL, rank=self.state.rank_info.rank,
+                       role="runtime", error=str(err)[:200])
+            _fr.trigger_dump("fatal")
         self.tensor_queue.shutdown_flush(err)
         for fn in list(self._fatal_listeners):
             try:

@@ -11,6 +11,7 @@ import logging
 import time
 from typing import Dict, List, Set, Tuple
 
+from . import flight_recorder as _fr
 from . import metrics
 
 logger = logging.getLogger("horovod_tpu.stall")
@@ -68,8 +69,15 @@ class StallInspector:
                     f"({self.shutdown_time_s}s); aborting (set "
                     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=0 to disable).")
         if stalled_msgs:
+            # Flight-recorder attribution: what the implicated tensors
+            # last DID (submit/frame/replay events from the black-box
+            # ring), not just which ranks are waiting.
+            recent = _fr.recent_for_tensors(invalidate) \
+                if _fr.ENABLED and invalidate else []
             logger.warning(
                 "One or more tensors were submitted to be reduced/gathered "
-                "but some ranks have not yet submitted them. Stalled ops: %s",
-                "; ".join(stalled_msgs))
+                "but some ranks have not yet submitted them. Stalled ops: %s%s",
+                "; ".join(stalled_msgs),
+                (". Last recorder events: %s" % recent) if recent
+                else "")
         return invalidate

@@ -23,6 +23,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...common import failpoints as _fp
+from ...common import flight_recorder as _fr
 from ...common import metrics
 from ..hosts import (HostInfo, INVALID_SLOT_INFO, SlotInfo,
                      get_host_assignments)
@@ -281,6 +282,9 @@ class ElasticDriver:
             self._world_info["ckpt_latest_step"] = self._ckpt_latest
         if self._rendezvous is not None:
             self._rendezvous.init(self._host_assignments)
+        if _fr.ENABLED:
+            _fr.record(_fr.ELASTIC, rank="driver", event="epoch_plan",
+                       epoch=self._epoch, size=self._world_size)
         logger.info("elastic: epoch %d planned, size=%d hosts=%s",
                     self._epoch, self._world_size, list(current.keys()))
         self._publish_metrics()
@@ -440,6 +444,10 @@ class ElasticDriver:
                 "elastic: coordinator promoted rank %d (%s:%d) to "
                 "lost (%s); evicting", rank, slot.hostname,
                 slot.local_rank, notice.get("reason", "?"))
+            if _fr.ENABLED:
+                _fr.record(_fr.ELASTIC, rank="driver", event="evict",
+                           lost_rank=rank, epoch=epoch,
+                           reason=notice.get("reason", "?"))
             self._registry.record_failure(slot.hostname,
                                           slot.local_rank)
 

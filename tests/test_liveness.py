@@ -299,6 +299,15 @@ def test_mttr_smoke_kill_8_ranks():
     assert rec["bit_identical"]
     assert rec["mttr_s"] is not None and rec["mttr_s"] < 15.0
     assert rec["replay_reengaged"]
+    # Postmortem: the merged flight-recorder dumps must name the
+    # killed rank and carry a detect->promote->restore->resume
+    # breakdown summing to the measured MTTR (+-10%).
+    pm = rec["postmortem"]
+    assert pm["ok"], pm
+    assert pm["failed_rank"] == rec["victim"]
+    assert pm["spans_sum_matches_mttr"]
+    assert abs(pm["spans"]["total"] - rec["mttr_s"]) \
+        <= 0.10 * rec["mttr_s"]
 
 
 @pytest.mark.chaos

@@ -145,3 +145,63 @@ def test_validate_trace_rejects_malformed(tmp_path):
          "ts": 2.5, "args": {"pending": 3}},
     ]))
     assert validate_trace.validate_file(str(ok)) == []
+
+
+def test_validate_trace_merged_mode(tmp_path):
+    """Merged-trace invariants (blackbox_merge output): B/E pairs
+    match per (pid, tid) — never across ranks — every lane's
+    timestamps are monotone, and a single-pid "merge" is rejected."""
+    # Spans on two pids may interleave in time; pairing is per pid.
+    good = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "rank 0"}},
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "rank 1"}},
+        {"ph": "B", "name": "detect", "pid": 0, "tid": 1, "ts": 1.0},
+        {"ph": "i", "name": "frame_rx", "pid": 1, "tid": 1, "ts": 1.5,
+         "s": "t"},
+        {"ph": "E", "pid": 0, "tid": 1, "ts": 2.0},
+        {"ph": "B", "name": "restore", "pid": 1, "tid": 1, "ts": 3.0},
+        {"ph": "E", "pid": 1, "tid": 1, "ts": 4.0},
+    ]
+    p = tmp_path / "merged_ok.json"
+    p.write_text(json.dumps(good))
+    assert validate_trace.validate_file(str(p), merged=True) == []
+
+    # An E on pid 1 must NOT close a B opened on pid 0.
+    cross = [
+        {"ph": "B", "name": "x", "pid": 0, "tid": 1, "ts": 1.0},
+        {"ph": "E", "pid": 1, "tid": 1, "ts": 2.0},
+    ]
+    p = tmp_path / "merged_cross.json"
+    p.write_text(json.dumps(cross))
+    errs = validate_trace.validate_file(str(p), merged=True)
+    assert any("without a matching" in e for e in errs), errs
+    assert any("unclosed" in e for e in errs), errs
+
+    # Time running backwards inside one rank's lane = bad clock merge.
+    backwards = [
+        {"ph": "i", "name": "a", "pid": 0, "tid": 1, "ts": 5.0,
+         "s": "t"},
+        {"ph": "i", "name": "b", "pid": 0, "tid": 1, "ts": 1.0,
+         "s": "t"},
+        {"ph": "i", "name": "c", "pid": 1, "tid": 1, "ts": 0.5,
+         "s": "t"},
+    ]
+    p = tmp_path / "merged_backwards.json"
+    p.write_text(json.dumps(backwards))
+    errs = validate_trace.validate_file(str(p), merged=True)
+    assert any("moved backwards" in e for e in errs), errs
+
+    # A merge that dropped every rank but one is not a merge.
+    single = [{"ph": "i", "name": "a", "pid": 0, "tid": 1, "ts": 1.0,
+               "s": "t"}]
+    p = tmp_path / "merged_single.json"
+    p.write_text(json.dumps(single))
+    errs = validate_trace.validate_file(str(p), merged=True)
+    assert any("at least 2" in e for e in errs), errs
+
+    # CLI: --merged exits nonzero on the same defect.
+    assert validate_trace.main(["--merged", str(p)]) == 1
+    assert validate_trace.main([str(tmp_path / "merged_ok.json"),
+                                "--merged"]) == 0

@@ -58,6 +58,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from horovod_tpu.common import failpoints, metrics  # noqa: E402
+from horovod_tpu.common import flight_recorder  # noqa: E402
 from horovod_tpu.common.env import Knobs  # noqa: E402
 from horovod_tpu.common.message import (Request, RequestType,  # noqa: E402
                                         dtype_of)
@@ -1366,6 +1367,71 @@ def run_delta_chain_drill(ranks: int = 4, seed: int = 0,
 # MTTR drill: detect -> restore -> resume, with a number on it
 # ---------------------------------------------------------------------------
 
+def _arm_blackbox() -> str:
+    """Arm the flight recorder for a drill with its own dump dir (the
+    drill-end dump + failure-trigger dumps both land there)."""
+    import tempfile
+    bb_dir = tempfile.mkdtemp(prefix="hvd-blackbox-")
+    flight_recorder.reset()
+    flight_recorder.configure(directory=bb_dir, capacity=1 << 16,
+                              enabled=True)
+    return bb_dir
+
+
+def collect_postmortem(dump_dir: str, expect_rank=None,
+                       expect_relay=None,
+                       measured_mttr_s=None) -> dict:
+    """Drill-end postmortem: dump the armed recorder, run
+    tools/blackbox_merge.py over the per-rank dumps, validate the
+    merged chrome trace, and check the verdict against what the drill
+    actually did — the verdict must name the killed rank/relay from
+    the EVENTS, and its span breakdown must sum to the measured MTTR
+    (±10%).  Closes the loop on drills that previously only asserted
+    recovery happened."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import blackbox_merge
+    import validate_trace
+
+    rec = {"dump_dir_events": len(flight_recorder.events())}
+    paths = flight_recorder.dump("drill_end", directory=dump_dir)
+    rec["dumps"] = len(paths)
+    try:
+        trace, verdict = blackbox_merge.merge(dump_dir)
+    except blackbox_merge.MergeError as e:
+        rec.update({"ok": False, "error": str(e)})
+        return rec
+    trace_errors = validate_trace.validate_events(trace, merged=True)
+    fd = verdict.get("first_divergent_event") or {}
+    rec.update({
+        "failed_rank": verdict.get("failed_rank"),
+        "failed_relay": verdict.get("failed_relay"),
+        "first_divergent_event": {k: fd.get(k) for k in
+                                  ("kind", "reason", "peer", "relay")},
+        "spans": verdict.get("spans"),
+        "mttr_s": verdict.get("mttr_s"),
+        "trace_events": len(trace),
+        "trace_errors": trace_errors[:5],
+    })
+    ok = not trace_errors and rec["dumps"] >= 2
+    if expect_rank is not None:
+        rec["named_victim"] = verdict.get("failed_rank") == expect_rank
+        ok = ok and rec["named_victim"]
+    if expect_relay is not None:
+        rec["named_relay"] = \
+            verdict.get("failed_relay") == expect_relay
+        ok = ok and rec["named_relay"]
+    if measured_mttr_s:
+        total = (verdict.get("spans") or {}).get("total")
+        rec["spans_sum_matches_mttr"] = (
+            total is not None and
+            abs(total - measured_mttr_s) <= 0.10 * measured_mttr_s)
+        ok = ok and rec["spans_sum_matches_mttr"]
+    rec["ok"] = ok
+    return rec
+
+
 def _percentile(values, q):
     """Nearest-rank percentile of a list (None when empty)."""
     if not values:
@@ -1439,6 +1505,9 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
     assert when in ("idle", "during_replay", "during_negotiation"), when
     t0 = time.monotonic()
     failpoints.reset()
+    # Black-box flight recorder armed for the whole drill: the per-rank
+    # dumps merge into the postmortem verdict asserted below.
+    bb_dir = _arm_blackbox()
     rng = random.Random("%d|mttr|%s|%s" % (seed, fault, when))
     victim = rng.randrange(1, ranks)
     shape = (193,)
@@ -1482,6 +1551,8 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
 
         def fire_fault():
             t_fault_box["t"] = time.monotonic()
+            flight_recorder.note("drill.fault", fault=fault,
+                                 when=when, victim=victim)
             if fault == "kill":
                 world.kill_rank(victim)
             elif fault == "wedge":
@@ -1702,6 +1773,17 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
             if len(first_step_done) == ranks else None
         if resume_s is not None:
             RECOVERY_SECONDS.observe(resume_s, phase="resume")
+        if first_step_done:
+            # Stamp the resumption marker at its TRUE time (the first
+            # post-restore step completed a moment ago on a worker
+            # thread) so the postmortem span breakdown partitions
+            # exactly the measured fault->resume window.
+            flight_recorder.note("drill.resumed",
+                                 mono=max(first_step_done.values()),
+                                 ranks=len(first_step_done))
+        postmortem = collect_postmortem(
+            bb_dir, expect_rank=victim, measured_mttr_s=mttr_s)
+        record["postmortem"] = postmortem
         record.update({
             "restored_step": restored_step,
             "bit_identical": bit_identical,
@@ -1713,6 +1795,7 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
             "errors": errors, "results_bad": results_bad,
             "ok": (detect_s is not None and bit_identical and
                    mttr_s is not None and replay_reengaged and
+                   postmortem.get("ok", False) and
                    not errors and not results_bad),
         })
         return record
@@ -1723,7 +1806,9 @@ def run_mttr_drill(fault: str = "kill", when: str = "idle",
                     w.close()
                 except Exception:
                     pass
+        flight_recorder.reset()
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+        shutil.rmtree(bb_dir, ignore_errors=True)
         record["elapsed_s"] = round(time.monotonic() - t0, 3)
 
 
@@ -1787,6 +1872,9 @@ def run_relay_drill(fault: str = "kill", when: str = "negotiation",
     assert when in ("idle", "negotiation", "replay"), when
     t0 = time.monotonic()
     failpoints.reset()
+    # Black-box recorder: the postmortem must name the killed relay
+    # from the per-rank dumps alone.
+    bb_dir = _arm_blackbox()
     grace = 4.0 * liveness_interval_s
     base_timeout = 2.0 * liveness_interval_s
     rehomes = _hm.REGISTRY.counter("hvd_relay_rehomes_total")
@@ -1863,6 +1951,8 @@ def run_relay_drill(fault: str = "kill", when: str = "negotiation",
 
         def fire():
             fired["t"] = time.monotonic()
+            flight_recorder.note("drill.fault", fault=fault,
+                                 when=when, relay=victim)
             if fault == "kill":
                 world.kill_relay(victim)
             elif fault == "wedge":
@@ -1890,10 +1980,22 @@ def run_relay_drill(fault: str = "kill", when: str = "negotiation",
             time.sleep(0.02)
         rehome_s = time.monotonic() - fired["t"]
         rehomed = resumed() - resumed0
+        if rehomed >= len(subtree):
+            # Resumption marker at the observed re-home completion so
+            # the postmortem's span breakdown covers fault->re-home.
+            flight_recorder.note("drill.resumed", rehomed=int(rehomed))
         # Phase C: verification traffic with FRESH names — forces full
         # negotiation rounds through every re-homed path.
         step_all("verify", post_steps,
                  lambda i: "relay.%s.v%d" % (fault, i), base=1000)
+        # Postmortem: the merged dumps alone must name the dead relay,
+        # and (when the subtree fully re-homed) the span breakdown
+        # must sum to the measured fault->re-home window.
+        postmortem = collect_postmortem(
+            bb_dir, expect_relay=victim,
+            measured_mttr_s=rehome_s if rehomed >= len(subtree)
+            else None)
+        record["postmortem"] = postmortem
         record.update({
             "rehomed": int(rehomed),
             "rehome_s": round(rehome_s, 3),
@@ -1902,7 +2004,8 @@ def run_relay_drill(fault: str = "kill", when: str = "negotiation",
             "results_bad": results_bad,
             "ok": (not hangs and not errors and not results_bad and
                    not fatal_times and rehomed >= len(subtree) and
-                   rehome_s <= rehome_bound_s),
+                   rehome_s <= rehome_bound_s and
+                   postmortem.get("ok", False)),
         })
         return record
     finally:
@@ -1910,6 +2013,8 @@ def run_relay_drill(fault: str = "kill", when: str = "negotiation",
             world.close()
         except Exception:
             pass
+        flight_recorder.reset()
+        shutil.rmtree(bb_dir, ignore_errors=True)
         record["elapsed_s"] = round(time.monotonic() - t0, 3)
 
 

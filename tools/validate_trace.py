@@ -1,21 +1,34 @@
 """Chrome-trace well-formedness checker for Horovod timeline output.
 
-Validates the JSON the TimelineWriter produces (common/timeline.py)
-against the chrome://tracing event-format rules this repo relies on:
+Validates the JSON the TimelineWriter produces (common/timeline.py) —
+and, in ``--merged`` mode, the cross-rank postmortem traces
+``tools/blackbox_merge.py`` builds — against the chrome://tracing
+event-format rules this repo relies on:
 
   * top level is an array of event objects, each with a phase ``ph``;
   * duration events balance: every ``E`` has a matching earlier ``B``
-    on the same tid, and no tid ends with an open span;
+    on the same (pid, tid) lane, and no lane ends with an open span —
+    in a merged multi-rank trace each rank is its own pid, so B/E
+    pairing is checked per rank, never across ranks;
   * timestamps are non-negative numbers, and B/E timestamps are
-    non-decreasing per tid (spans come from causally ordered
+    non-decreasing per lane (spans come from causally ordered
     lifecycle transitions of one tensor);
   * metadata (``M``) events carry ``args.name`` (the tid→tensor map);
   * counter (``C``) events carry an ``args`` dict of numeric series.
 
-Usable as a library (``validate_events`` / ``validate_file`` return a
-list of error strings, empty = valid) and as a CLI::
+Merged mode adds the postmortem invariants:
 
-    python tools/validate_trace.py /tmp/timeline.json [...]
+  * EVERY timestamped event is non-decreasing per (pid, tid) lane —
+    a clock-alignment bug in the merge shows up as time running
+    backwards inside one rank's lane;
+  * at least two pids are present (a "merged" trace of one rank is a
+    merge that silently dropped its inputs).
+
+Usable as a library (``validate_events`` / ``validate_file`` return a
+list of error strings, empty = valid) and as a CLI (exits nonzero on
+malformed input)::
+
+    python tools/validate_trace.py [--merged] TRACE_JSON [...]
 """
 
 import json
@@ -27,12 +40,14 @@ _PASSTHROUGH_PHASES = {"i", "I", "X", "b", "e", "n", "s", "t", "f",
                        "N", "O", "D", "P"}
 
 
-def validate_events(events) -> List[str]:
+def validate_events(events, merged: bool = False) -> List[str]:
     errors: List[str] = []
     if not isinstance(events, list):
         return ["top-level JSON must be an array of trace events"]
-    depth = {}      # tid -> open B count
-    last_ts = {}    # tid -> last B/E timestamp
+    depth = {}      # (pid, tid) -> open B count
+    last_ts = {}    # (pid, tid) -> last B/E timestamp
+    last_any = {}   # (pid, tid) -> last timestamp of ANY event (merged)
+    pids = set()
     for i, e in enumerate(events):
         if not isinstance(e, dict) or "ph" not in e:
             errors.append("event %d: not an object with a 'ph' phase"
@@ -51,24 +66,31 @@ def validate_events(events) -> List[str]:
             errors.append("event %d: missing or negative ts (%r)"
                           % (i, ts))
             continue
-        tid = e.get("tid", 0)
-        if ph in ("B", "E"):
-            if ts < last_ts.get(tid, 0.0):
+        lane = (e.get("pid", 0), e.get("tid", 0))
+        pids.add(e.get("pid", 0))
+        if merged:
+            if ts < last_any.get(lane, 0.0):
                 errors.append(
-                    "event %d: ts moved backwards on tid %r "
-                    "(%r < %r)" % (i, tid, ts, last_ts[tid]))
-            last_ts[tid] = max(last_ts.get(tid, 0.0), ts)
+                    "event %d: merged ts moved backwards on lane %r "
+                    "(%r < %r)" % (i, lane, ts, last_any[lane]))
+            last_any[lane] = max(last_any.get(lane, 0.0), ts)
+        if ph in ("B", "E"):
+            if ts < last_ts.get(lane, 0.0):
+                errors.append(
+                    "event %d: ts moved backwards on lane %r "
+                    "(%r < %r)" % (i, lane, ts, last_ts[lane]))
+            last_ts[lane] = max(last_ts.get(lane, 0.0), ts)
             if ph == "B":
                 if "name" not in e:
                     errors.append("event %d: 'B' without a name" % i)
-                depth[tid] = depth.get(tid, 0) + 1
+                depth[lane] = depth.get(lane, 0) + 1
             else:
-                depth[tid] = depth.get(tid, 0) - 1
-                if depth[tid] < 0:
+                depth[lane] = depth.get(lane, 0) - 1
+                if depth[lane] < 0:
                     errors.append(
                         "event %d: 'E' without a matching 'B' on "
-                        "tid %r" % (i, tid))
-                    depth[tid] = 0
+                        "lane %r" % (i, lane))
+                    depth[lane] = 0
         elif ph == "C":
             args = e.get("args")
             if not isinstance(args, dict) or not all(
@@ -79,30 +101,38 @@ def validate_events(events) -> List[str]:
                     "event %d: 'C' without a numeric args dict" % i)
         elif ph not in _PASSTHROUGH_PHASES:
             errors.append("event %d: unknown phase %r" % (i, ph))
-    for tid, d in sorted(depth.items(), key=lambda kv: str(kv[0])):
+    for lane, d in sorted(depth.items(), key=lambda kv: str(kv[0])):
         if d != 0:
-            errors.append("tid %r: %d unclosed 'B' span(s)" % (tid, d))
+            errors.append("lane %r: %d unclosed 'B' span(s)"
+                          % (lane, d))
+    if merged and len(pids) < 2:
+        errors.append("merged trace contains %d pid(s); a cross-rank "
+                      "merge needs at least 2" % len(pids))
     return errors
 
 
-def validate_file(path: str) -> List[str]:
+def validate_file(path: str, merged: bool = False) -> List[str]:
     try:
         with open(path) as f:
             events = json.load(f)
     except (OSError, ValueError) as e:
         return ["%s: unreadable or invalid JSON: %s" % (path, e)]
-    return validate_events(events)
+    return validate_events(events, merged=merged)
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    merged = False
+    if "--merged" in argv:
+        merged = True
+        argv.remove("--merged")
     if not argv:
-        print("usage: validate_trace.py TIMELINE_JSON [...]",
-              file=sys.stderr)
+        print("usage: validate_trace.py [--merged] TIMELINE_JSON "
+              "[...]", file=sys.stderr)
         return 2
     rc = 0
     for path in argv:
-        errors = validate_file(path)
+        errors = validate_file(path, merged=merged)
         if errors:
             rc = 1
             for err in errors:
