@@ -1063,6 +1063,7 @@ if RANK == 0:
         "results": results, "frames": stats,
         "metrics": metrics_snap,
         "replay": replay_stats,
+        "tune": hvd.tune_status(),
         "backend": {"type": type(basics._state().backend).__name__,
                     "ring_shm": backend_stats.get("ring_shm"),
                     "ring_allreduces":
@@ -1262,6 +1263,294 @@ hvd.shutdown()
 """
 
 
+# Tuned-vs-default lane worker (autotune-then-freeze, docs/autotune.md):
+# phase 1 drives a fixed tiny+bulk allreduce mix until the tuning
+# session FREEZES (tuned lane) or an equivalent warm-round budget
+# elapses (default lane), so both lanes measure after comparable warm
+# history; phase 2 measures the steady-state replay floor and bulk
+# GB/s under whichever knobs are live, sampling the uplink counters to
+# prove the replay window is wire-free in both lanes.
+_TUNE_WORKER_SRC = r"""
+import json, os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+RANK, SIZE = hvd.rank(), hvd.size()
+from horovod_tpu.common import basics
+state = basics._state()
+rt = state.runtime
+rp = rt.replay
+
+payload_mb = float(os.environ.get("BENCH_TUNE_MB", "1"))
+buf = np.ones(int(payload_mb * (1 << 20) // 4), np.float32)
+tiny = np.ones(1, np.float32)
+
+
+def one_round():
+    hvd.allreduce(tiny, op=hvd.Sum, name="tune.tiny")
+    hvd.allreduce(buf, op=hvd.Sum, name="tune.buf")
+
+
+deadline = time.monotonic() + float(
+    os.environ.get("BENCH_TUNE_WARM_S", "90"))
+warm_budget = int(os.environ.get("BENCH_TUNE_WARM_ROUNDS", "60"))
+warm_rounds = 0
+while time.monotonic() < deadline:
+    one_round()
+    warm_rounds += 1
+    st = hvd.tune_status()
+    if st is None:
+        if warm_rounds >= warm_budget:
+            break
+    elif st.get("phase") in ("frozen", "aborted"):
+        break
+status = hvd.tune_status()
+frozen = bool(status and status.get("phase") == "frozen")
+
+for _ in range(10):   # let replay converge + engage under final knobs
+    one_round()
+replay_active = bool(rp is not None and rp.stats()["active"])
+
+
+def timed_floor(fn, warmup=5, chunks=5, per=40):
+    for _ in range(warmup):
+        fn()
+    ms = []
+    for _ in range(chunks):
+        t0 = time.perf_counter()
+        for _ in range(per):
+            fn()
+        ms.append((time.perf_counter() - t0) / per * 1e3)
+    ms.sort()
+    return {"median_ms": round(ms[len(ms) // 2], 3),
+            "best_ms": round(ms[0], 3),
+            "worst_ms": round(ms[-1], 3)}
+
+
+f0 = dict(rt.controller.stats)
+floor = timed_floor(lambda: hvd.allreduce(tiny, op=hvd.Sum,
+                                          name="tune.tiny"))
+f1 = dict(rt.controller.stats)
+frames_during_floor = sum(
+    f1[k] - f0[k] for k in ("rq_frames", "ch_frames"))
+
+reps = int(os.environ.get("BENCH_TUNE_BULK_REPS", "30"))
+t0 = time.perf_counter()
+for _ in range(reps):
+    hvd.allreduce(buf, op=hvd.Sum, name="tune.buf")
+dt = time.perf_counter() - t0
+gbps = buf.nbytes * reps / dt / 2**30
+
+if RANK == 0:
+    print("BENCHJSON " + json.dumps({
+        "warm_rounds": warm_rounds,
+        "frozen": frozen,
+        "replay_active": replay_active,
+        "tiny_floor": floor,
+        "tiny_floor_ms": floor["median_ms"],
+        "uplink_frames_during_floor": frames_during_floor,
+        "bulk_mb": payload_mb,
+        "bulk_gbps": round(gbps, 4),
+        "tune": status,
+        "knobs": {
+            "fusion_mb": state.knobs.fusion_threshold_bytes / 2**20,
+            "cycle_time_ms": state.knobs.cycle_time_ms,
+            "coalesce": state.knobs.request_coalescing,
+            "replay_warmup": state.knobs.replay_warmup_cycles,
+        },
+    }))
+hvd.shutdown()
+"""
+
+
+def _tune_env(profile_path=None, max_samples=None):
+    """The env contract for a tuned bench pass: deterministic grid
+    strategy at bench-scale window sizes (the gp strategy is the
+    production default; the lane pins grid so artifact deltas are
+    reproducible round over round)."""
+    env = {
+        "HOROVOD_TUNE": "1",
+        "HOROVOD_TUNE_STRATEGY": "grid",
+        "HOROVOD_TUNE_CYCLES_PER_SAMPLE": "2",
+        "HOROVOD_TUNE_WARMUP_WINDOWS": "1",
+    }
+    if profile_path:
+        env["HOROVOD_TUNE_PROFILE"] = profile_path
+    if max_samples:
+        env["HOROVOD_TUNE_MAX_SAMPLES"] = str(max_samples)
+    return env
+
+
+def _run_benchjson_workers(src: str, nproc: int, extra_env=None,
+                           timeout=900) -> dict:
+    """Spawn ``nproc`` env-contract CPU worker processes running
+    ``src`` and parse rank 0's BENCHJSON line — the shared scaffolding
+    of every multi-process lane (tune, dlrm)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    coord_port, ctrl_port = _free_ports(2)
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(nproc),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(nproc),
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_TPU_COORDINATOR": "127.0.0.1:%d" % coord_port,
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1:%d" % ctrl_port,
+            "HOROVOD_TPU_FORCE_CPU": "1",
+            "PYTHONPATH": repo,
+        })
+        env.update(extra_env or {})
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", src], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+    for rc, out in zip((p.returncode for p in procs), outs):
+        if rc != 0:
+            return {"error": "worker rc=%s: %s" % (rc, out[-800:])}
+    for line in outs[0].splitlines():
+        if line.startswith("BENCHJSON "):
+            return json.loads(line[len("BENCHJSON "):])
+    return {"error": "no result line: %s" % outs[0][-800:]}
+
+
+def _run_tune_workers(nproc: int, extra_env=None, timeout=600):
+    return _run_benchjson_workers(_TUNE_WORKER_SRC, nproc,
+                                  extra_env=extra_env, timeout=timeout)
+
+
+def bench_tune(args, smoke: bool) -> dict:
+    """The autotune-then-freeze lane: the same tiny+bulk workload
+    measured under default knobs and under a tuned warmup→freeze run
+    (grid strategy, a real multi-rank world), reporting floor-ms and
+    GB/s deltas plus the frozen profile itself.  The acceptance gate a
+    tuned run must meet: never regress the default-knob headline
+    (check_tune_regression warns when it does)."""
+    import tempfile
+    nproc = int(os.environ.get("HOROVOD_BENCH_TUNE_RANKS", "4"))
+    # Smoke scales down like every other lane: shorter warm budget,
+    # smaller bulk section, and a tighter sample cap so the grid
+    # force-converges within the budget.
+    sizing = {"BENCH_TUNE_WARM_ROUNDS": "30" if smoke else "60",
+              "BENCH_TUNE_WARM_S": "60" if smoke else "120",
+              "BENCH_TUNE_BULK_REPS": "12" if smoke else "30"}
+    out = {"nproc": nproc, "platform": "cpu"}
+    default = _run_tune_workers(nproc, extra_env=dict(sizing))
+    out["default"] = default
+    if "error" in default:
+        return out
+    prof_dir = tempfile.mkdtemp(prefix="hvd-bench-tune-")
+    prof_path = os.path.join(prof_dir, "profile.json")
+    tuned = _run_tune_workers(
+        nproc, extra_env=dict(
+            sizing, **_tune_env(prof_path,
+                                max_samples=8 if smoke else None)))
+    out["tuned"] = tuned
+    if "error" in tuned:
+        return out
+    try:
+        with open(prof_path) as f:
+            out["profile"] = json.loads(f.read())
+    except (OSError, ValueError):
+        out["profile"] = None
+    # Reload pass: a restart with the frozen profile must skip the
+    # search entirely (zero warm rounds spent searching — the session
+    # starts frozen) and still engage replay.
+    reload_run = _run_tune_workers(
+        nproc, extra_env=dict(sizing, BENCH_TUNE_WARM_ROUNDS="12",
+                              **_tune_env(prof_path)))
+    out["reloaded"] = reload_run
+    d_floor = default.get("tiny_floor_ms")
+    t_floor = tuned.get("tiny_floor_ms")
+    if d_floor and t_floor:
+        out["tuned_vs_default"] = {
+            "floor_delta_ms": round(t_floor - d_floor, 3),
+            "floor_delta_pct": round(
+                (t_floor - d_floor) / d_floor * 100.0, 1),
+            "gbps_delta_pct": round(
+                (tuned["bulk_gbps"] - default["bulk_gbps"])
+                / default["bulk_gbps"] * 100.0, 1)
+            if default.get("bulk_gbps") else None,
+            "frozen": tuned.get("frozen"),
+            "replay_active_both": bool(
+                default.get("replay_active")
+                and tuned.get("replay_active")),
+        }
+    return out
+
+
+def check_tune_regression(out: dict, repo_dir: str):
+    """The tuned lane's gates: (1) same-artifact — a tuned run must
+    never regress the default-knob headline beyond the floor
+    measurement's own spread; (2) artifact-to-artifact — the tuned
+    floor must not regress beyond the noise band vs the prior round's
+    tune lane (the smoke/recovery-lane precedent)."""
+    cur = out.get("tune") or {}
+    cmp = cur.get("tuned_vs_default") or {}
+    default = cur.get("default") or {}
+    tuned = cur.get("tuned") or {}
+    if cmp:
+        floor = default.get("tiny_floor") or {}
+        spread_pct = 10.0
+        if floor.get("median_ms"):
+            spread_pct = max(
+                10.0, (floor.get("worst_ms", 0) -
+                       floor.get("best_ms", 0))
+                / floor["median_ms"] * 100.0)
+        if (cmp.get("floor_delta_pct") or 0) > spread_pct:
+            print("WARNING: the TUNED run regressed the default-knob "
+                  "tiny-op floor by %.1f%% (%.3f -> %.3f ms), beyond "
+                  "the %.1f%% spread band — autotune-then-freeze must "
+                  "never lose to the defaults"
+                  % (cmp["floor_delta_pct"],
+                     default.get("tiny_floor_ms", -1),
+                     tuned.get("tiny_floor_ms", -1), spread_pct),
+                  file=sys.stderr)
+            cmp["regressed_vs_default"] = True
+        if cmp.get("gbps_delta_pct") is not None and \
+                cmp["gbps_delta_pct"] < -spread_pct:
+            print("WARNING: the TUNED run regressed default bulk GB/s "
+                  "by %.1f%%, beyond the %.1f%% band"
+                  % (-cmp["gbps_delta_pct"], spread_pct),
+                  file=sys.stderr)
+            cmp["regressed_vs_default"] = True
+        if not tuned.get("frozen"):
+            print("WARNING: the tune lane never froze (phase %s) — "
+                  "the warmup budget is too small or the search "
+                  "wedged" % ((tuned.get("tune") or {}).get("phase")),
+                  file=sys.stderr)
+    prior = _prior_bench_value(
+        repo_dir, r'"tune\\?":.*?"tuned\\?":.*?"tiny_floor_ms\\?":\s*'
+                  r'(-?[0-9.]+)')
+    t_floor = tuned.get("tiny_floor_ms")
+    if prior is not None and t_floor:
+        prior_v, src = prior
+        tol_pct = 30.0  # micro-floor on a shared core
+        delta_pct = (t_floor - prior_v) / prior_v * 100.0
+        cur["tune_vs_prior"] = {
+            "prior_tiny_floor_ms": prior_v, "prior_source": src,
+            "delta_pct": round(delta_pct, 1),
+            "tolerance_pct": tol_pct,
+            "regressed": delta_pct > tol_pct,
+        }
+        if cur["tune_vs_prior"]["regressed"]:
+            print("WARNING: tuned tiny-op floor regressed %.1f%% vs "
+                  "%s (%.3f -> %.3f ms), beyond the %.0f%% band"
+                  % (delta_pct, src, prior_v, t_floor, tol_pct),
+                  file=sys.stderr)
+
+
 def _free_ports(n):
     import socket
     socks, ports = [], []
@@ -1278,7 +1567,7 @@ def _free_ports(n):
 
 
 def bench_collectives(sizes_mb, nproc=2, timeout=600,
-                      plane=None, iters_cap=0) -> dict:
+                      plane=None, iters_cap=0, extra_env=None) -> dict:
     """Spawn nproc CPU worker processes exercising hvd.allreduce through
     the full eager path: TCP controller + cache fast path + steady-state
     replay + the data plane (default = native ring incl. same-host shm;
@@ -1307,6 +1596,7 @@ def bench_collectives(sizes_mb, nproc=2, timeout=600,
         env.pop("HOROVOD_CPU_OPERATIONS", None)
         if plane:
             env["HOROVOD_CPU_OPERATIONS"] = plane
+        env.update(extra_env or {})
         env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _WORKER_SRC], env=env,
@@ -1352,6 +1642,41 @@ def bench_scale(args, smoke: bool) -> dict:
     misses = float(cache.get("event=miss", 0.0))
     data["cache_hit_rate"] = round(hits / (hits + misses), 4) \
         if hits + misses else None
+    # Tuned-vs-default pass (autotune-then-freeze): the same lane with
+    # HOROVOD_TUNE=1 — the search runs during the sized loops (the
+    # production warmup shape), the freeze happens before the control-
+    # floor section, so the floor deltas compare tuned replay against
+    # default replay.
+    try:
+        import tempfile
+        prof = os.path.join(tempfile.mkdtemp(prefix="hvd-scale-tune-"),
+                            "profile.json")
+        tuned = bench_collectives(
+            sizes, nproc=nproc, timeout=900, iters_cap=24,
+            extra_env=_tune_env(prof, max_samples=8))
+        if "error" not in tuned:
+            d_floor = (data.get("control_floor") or {}).get(
+                "tiny_replay_ms")
+            t_floor = (tuned.get("control_floor") or {}).get(
+                "tiny_replay_ms")
+            d_gbps = next((r["gbps"] for r in data.get("results", [])
+                           if r.get("input") == "numpy"), None)
+            t_gbps = next((r["gbps"] for r in tuned.get("results", [])
+                           if r.get("input") == "numpy"), None)
+            data["tuned_vs_default"] = {
+                "tuned_tiny_replay_ms": t_floor,
+                "default_tiny_replay_ms": d_floor,
+                "floor_delta_ms": round(t_floor - d_floor, 3)
+                if (t_floor and d_floor) else None,
+                "gbps_delta_pct": round(
+                    (t_gbps - d_gbps) / d_gbps * 100.0, 1)
+                if (t_gbps and d_gbps) else None,
+                "tune": tuned.get("tune"),
+            }
+        else:
+            data["tuned_vs_default"] = {"error": tuned["error"]}
+    except Exception as e:
+        data["tuned_vs_default"] = {"error": repr(e)[:300]}
     # The full registry snapshot is already in the 2-proc lane when
     # that lane runs; under --only scale this is the only snapshot,
     # so keep it.
@@ -1466,45 +1791,58 @@ def bench_dlrm(args, smoke: bool) -> dict:
     delta_vs_full_bytes_ratio the Check-N-Run compression claim is
     gated on."""
     nproc = int(os.environ.get("HOROVOD_BENCH_DLRM_RANKS", "8"))
-    repo = os.path.dirname(os.path.abspath(__file__))
-    coord_port, ctrl_port = _free_ports(2)
-    procs = []
-    for rank in range(nproc):
-        env = dict(os.environ)
-        env.update({
-            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(nproc),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(nproc),
-            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
-            "HOROVOD_TPU_COORDINATOR": "127.0.0.1:%d" % coord_port,
-            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1:%d" % ctrl_port,
-            "HOROVOD_TPU_FORCE_CPU": "1",
-            "BENCH_DLRM_STEPS": "9" if smoke else "24",
-            "PYTHONPATH": repo,
-        })
-        env.pop("XLA_FLAGS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _DLRM_WORKER_SRC], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=900)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-        outs.append(out.decode(errors="replace"))
-    for rc, out in zip((p.returncode for p in procs), outs):
-        if rc != 0:
-            return {"error": "worker rc=%s: %s" % (rc, out[-800:])}
-    for line in outs[0].splitlines():
-        if line.startswith("BENCHJSON "):
-            data = json.loads(line[len("BENCHJSON "):])
-            data["platform"] = "cpu"
-            if args.only != "dlrm":
-                data.pop("metrics", None)
-            return data
-    return {"error": "no result line: %s" % outs[0][-800:]}
+    data = _run_dlrm_workers(nproc, smoke)
+    if "error" in data:
+        return data
+    data["platform"] = "cpu"
+    # Tuned-vs-default pass: the DLRM loop is the sparse cycle-class
+    # workload (three alltoalls per table per step + one dense
+    # allreduce) — the pass proves the per-class search converges on
+    # BOTH classes and reports the steps/s + alltoall GB/s deltas.
+    # max_samples is capped so the grid force-converges inside the
+    # lane's step budget.
+    try:
+        import tempfile
+        prof = os.path.join(tempfile.mkdtemp(prefix="hvd-dlrm-tune-"),
+                            "profile.json")
+        tuned = _run_dlrm_workers(
+            nproc, smoke, extra_env=_tune_env(prof, max_samples=6))
+        if "error" not in tuned:
+            d_sps, t_sps = data.get("steps_per_sec"), \
+                tuned.get("steps_per_sec")
+            d_gbps, t_gbps = data.get("alltoall_gbps"), \
+                tuned.get("alltoall_gbps")
+            try:
+                with open(prof) as f:
+                    profile = json.loads(f.read())
+            except (OSError, ValueError):
+                profile = None
+            data["tuned_vs_default"] = {
+                "tuned_steps_per_sec": t_sps,
+                "steps_per_sec_delta_pct": round(
+                    (t_sps - d_sps) / d_sps * 100.0, 1)
+                if (t_sps and d_sps) else None,
+                "alltoall_gbps_delta_pct": round(
+                    (t_gbps - d_gbps) / d_gbps * 100.0, 1)
+                if (t_gbps and d_gbps) else None,
+                "profile_classes": sorted((profile or {}).get(
+                    "classes") or []),
+                "frozen": bool(profile),
+            }
+        else:
+            data["tuned_vs_default"] = {"error": tuned["error"]}
+    except Exception as e:
+        data["tuned_vs_default"] = {"error": repr(e)[:300]}
+    if args.only != "dlrm":
+        data.pop("metrics", None)
+    return data
+
+
+def _run_dlrm_workers(nproc: int, smoke: bool, extra_env=None) -> dict:
+    env = {"BENCH_DLRM_STEPS": "9" if smoke else "24"}
+    env.update(extra_env or {})
+    return _run_benchjson_workers(_DLRM_WORKER_SRC, nproc,
+                                  extra_env=env, timeout=900)
 
 
 def _load_prior_dlrm(repo_dir: str):
@@ -1854,7 +2192,7 @@ def main():
                choices=["resnet", "bert", "keras",
                         "collectives", "checkpoint", "scale",
                         "recovery", "dlrm", "coordscale",
-                        "blackbox"],
+                        "blackbox", "tune"],
                    default=None)
     args = p.parse_args()
 
@@ -1909,7 +2247,8 @@ def main():
     run = {args.only} if args.only else {"resnet", "bert", "keras",
                                      "collectives", "checkpoint",
                                      "scale", "recovery", "dlrm",
-                                     "coordscale", "blackbox"}
+                                     "coordscale", "blackbox",
+                                     "tune"}
 
     resnet = {}
     if "resnet" in run:
@@ -1992,6 +2331,13 @@ def main():
         except Exception as e:
             out["blackbox"] = {"error": repr(e)[:300]}
         check_blackbox_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "tune" in run:
+        try:
+            out["tune"] = bench_tune(args, args.smoke)
+        except Exception as e:
+            out["tune"] = {"error": repr(e)[:300]}
+        check_tune_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:

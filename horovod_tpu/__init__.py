@@ -27,7 +27,8 @@ from .common.basics import (Adasum, Average, Max, Min, Product, Sum,
                             mpi_threads_supported, nccl_built, num_chips,
                             rank, remove_process_set, shutdown, size,
                             start_timeline, stop_timeline, cuda_built,
-                            rocm_built, ccl_built, xla_built, xla_enabled)
+                            rocm_built, ccl_built, tune_status,
+                            xla_built, xla_enabled)
 
 from .common.exceptions import (HorovodInternalError,
                                 HostsUpdatedInterrupt)
@@ -50,7 +51,7 @@ __all__ = [
     "gloo_built", "gloo_enabled", "nccl_built", "cuda_built", "rocm_built",
     "ccl_built", "xla_built", "xla_enabled",
     "start_timeline", "stop_timeline",
-    "metrics_snapshot", "cluster_metrics_snapshot",
+    "metrics_snapshot", "cluster_metrics_snapshot", "tune_status",
     "ProcessSet", "global_process_set", "add_process_set",
     "remove_process_set",
     # ops & op constants

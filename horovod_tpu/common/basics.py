@@ -92,7 +92,8 @@ class HorovodTpuState:
         self.runtime = None          # background negotiation runtime
         self.timeline = None
         self.metrics_server = None   # /metrics HTTP endpoint (opt-in)
-        self.parameter_manager = None
+        self.parameter_manager = None   # legacy HOROVOD_AUTOTUNE GP
+        self.tune_session = None     # autotune-then-freeze (rank 0)
         self.elastic_enabled = False
         self.host_messages = None    # elastic host-update queue
         self.is_homogeneous = True
@@ -371,6 +372,8 @@ def shutdown():
         if state.runtime is not None:
             state.runtime.detach()
             state.runtime = None
+        state.tune_session = None
+        state.parameter_manager = None
         if state.distributed_client_owned:
             _teardown_jax_distributed()
             state.distributed_client_owned = False
@@ -511,6 +514,39 @@ def cluster_metrics_snapshot():
     if server is None or not hasattr(server, "merged_metrics"):
         return None
     return server.merged_metrics()
+
+
+def tune_status() -> Optional[dict]:
+    """The autotune-then-freeze lifecycle view (docs/autotune.md).
+
+    On the rank hosting the tuning session (rank 0 with
+    ``HOROVOD_TUNE=1``) this is the session's full status — phase
+    (search/frozen/aborted), per-class sample counts and live/frozen
+    knobs.  On every other rank it is the worker-side view: the
+    currently applied worker knobs plus whether steady-state replay is
+    being held for an active search.  None before init or when tuning
+    was never enabled."""
+    state = _state()
+    sess = state.tune_session
+    if sess is not None:
+        return sess.status()
+    rt = state.runtime
+    if rt is None or not (state.knobs.tune or state.knobs.autotune
+                          or state.knobs.tune_profile_loaded):
+        return None
+    # The runtime's own lifecycle bit, not the replay tracker's hold:
+    # with replay disabled there is no tracker, but the search is
+    # still live until the freeze/abort announcement lands.
+    holding = bool(getattr(rt, "tuning_active", False))
+    return {
+        "phase": ("search" if holding else "frozen"),
+        "worker": {
+            "cycle_time_ms": state.knobs.cycle_time_ms,
+            "coalesce": state.knobs.request_coalescing,
+            "replay_warmup": state.knobs.replay_warmup_cycles,
+        },
+        "profile_loaded": state.knobs.tune_profile_loaded,
+    }
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False):

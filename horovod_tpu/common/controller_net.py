@@ -223,7 +223,8 @@ class CoordinatorServer:
                  reconnect_grace_s: float = 0.0,
                  registration_timeout_s: float = 30.0,
                  fanout: int = 0,
-                 on_rank_lost=None):
+                 on_rank_lost=None,
+                 tune_session=None):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
@@ -240,6 +241,18 @@ class CoordinatorServer:
         # configuration, nothing announced yet).
         self._synced_params_version = -1
         self._synced_params = None
+        # Autotune-then-freeze session (horovod_tpu/tune): scores
+        # every round per cycle-class, proposes knobs, freezes.  Its
+        # announcements ride the same PA frame + registration-replay
+        # machinery as the legacy param_manager; its per-class fusion
+        # thresholds are applied at fuse time below.  Priming
+        # _synced_params here makes the startup announcement (search
+        # active / profile-frozen) reach every rank at registration.
+        self.tune_session = tune_session
+        if tune_session is not None:
+            p = tune_session.take_announcement()
+            if p is not None:
+                self._synced_params = json.dumps(p).encode()
         self._table = MessageTable()
         self._seen = 0
         self._departed = 0
@@ -1622,6 +1635,16 @@ class CoordinatorServer:
             # summed into every future merge, and the ``ranks``
             # contributor list would keep advertising a dead process.
             self._rank_metrics.pop(rank, None)
+            if self.tune_session is not None and \
+                    self.tune_session.active:
+                # A rank died MID-SEARCH: abort to default knobs in
+                # one atomic PA — a proposal half-applied across the
+                # surviving ranks would poison the post-recovery
+                # world's same-schedule contract.  Survivors (elastic)
+                # or the teardown path (static) all see the same final
+                # default-knob payload.
+                self.tune_session.abort("rank_lost")
+                self._drain_tune_locked()
         if not self.elastic:
             return
         with self._lock:
@@ -1904,9 +1927,22 @@ class CoordinatorServer:
             self._cache.clear_tombstones_for(key)
 
         nbytes = 0
+        sess = self.tune_session
+        # Cycle-class of this round: any ALLTOALL response makes it
+        # sparse (the DLRM embedding exchange — per-step splits, never
+        # cacheable, so alltoall can only appear among the negotiated
+        # responses); everything else is dense.  The tuning session
+        # scores and searches the two classes independently, and the
+        # fusion threshold each fuse below uses is the CLASS's live
+        # proposal (hit batches are cacheable-only, hence dense).
+        sparse_round = any(
+            r.response_type == ResponseType.ALLTOALL
+            for r in full_responses)
         if hit_responses:
             fused_hits = fuse_responses(
-                hit_responses, self._elem_cache, self.fusion_threshold,
+                hit_responses, self._elem_cache,
+                sess.fusion_threshold_for(False) if sess is not None
+                else self.fusion_threshold,
                 self._group_ids)
             batches = [[self._cache.get((fr.process_set_id, n))[0]
                         for n in fr.tensor_names]
@@ -1921,7 +1957,10 @@ class CoordinatorServer:
                           for fr in fused_hits for n in fr.tensor_names)
         if full_responses:
             fused = fuse_responses(full_responses, self._elem_cache,
-                                   self.fusion_threshold, self._group_ids)
+                                   sess.fusion_threshold_for(sparse_round)
+                                   if sess is not None
+                                   else self.fusion_threshold,
+                                   self._group_ids)
             if self._cache.enabled:
                 self._assign_cache_bits(fused, sig_by_key)
             self._flush_evictions_locked()
@@ -1934,6 +1973,9 @@ class CoordinatorServer:
                           for fr in fused for n in fr.tensor_names)
         else:
             self._flush_evictions_locked()
+        if sess is not None:
+            sess.observe_round(nbytes, sparse=sparse_round)
+            self._drain_tune_locked()
         if self.param_manager is not None:
             if self.param_manager.active:
                 self.param_manager.record_step(nbytes)
@@ -1942,6 +1984,21 @@ class CoordinatorServer:
             if self.param_manager.params_version != \
                     self._synced_params_version:
                 self._sync_tuned_params_locked()
+
+    def _drain_tune_locked(self):
+        """Broadcast any queued tuning announcement (knob proposal,
+        freeze, abort) as a PA frame under the server lock, and keep
+        it as the registration-replay payload so late joiners and
+        resumed sessions see the current knob state.  Broadcasting
+        under the lock positions the frame identically in every
+        worker's response stream — all ranks flip knobs at the same
+        cycle boundary."""
+        payload = self.tune_session.take_announcement()
+        if payload is None:
+            return
+        data = json.dumps(payload).encode()
+        self._synced_params = data
+        self._broadcast_frame_locked(_MAGIC_PARAMS, data)
 
     def _sync_tuned_params_locked(self):
         """Announce the autotuner's categorical knobs to every worker
@@ -1962,6 +2019,11 @@ class CoordinatorServer:
             "hierarchical": bool(params["hierarchical"]),
             "cache": cache_on,
             "fusion": int(self.fusion_threshold),
+            # Lifecycle bit for the replay tracker: the legacy
+            # autotuner's convergence releases the replay hold exactly
+            # like a tune-session freeze — replay gates on "tuning
+            # still active", not on the blanket autotune knob.
+            "tuning_active": bool(pm.active),
         }).encode()
         self._synced_params = payload
         self._broadcast_frame_locked(_MAGIC_PARAMS, payload)
@@ -2274,6 +2336,11 @@ class NetworkController(Controller):
         # PA params stashed until the batches received before them have
         # executed (applied at the next compute_response_list entry).
         self._pending_params: Optional[dict] = None
+        # Runtime hook for tuned worker knobs (cycle time, coalescing,
+        # replay warmup/hold): _apply_params forwards the decoded PA
+        # payload so the runtime flips its knobs at the frame's
+        # position in the response stream.
+        self._params_hook = None
         # True while an MR (metrics snapshot) reply thread is in
         # flight; written only by the recv thread.
         self._mr_sending = False
@@ -2317,7 +2384,31 @@ class NetworkController(Controller):
             if addr and ":" in addr:
                 port = int(addr.rsplit(":", 1)[1])
             param_manager = None
-            if state.knobs.autotune:
+            tune_session = None
+            if state.knobs.tune:
+                # Autotune-then-freeze (horovod_tpu/tune): a valid
+                # profile at HOROVOD_TUNE_PROFILE means the search
+                # already ran — build a pre-frozen session (per-class
+                # thresholds from the artifact, startup announcement
+                # says tuning_active=false) so restarts and elastic
+                # resizes skip the re-search.  Takes precedence over
+                # the legacy HOROVOD_AUTOTUNE path.
+                from ..tune.session import TuningSession
+                # The SAME parsed artifact Knobs.from_env adopted —
+                # never a second read of the file, which could race a
+                # concurrent freeze replacing it and hand the session
+                # different knobs than the ones already applied.
+                prof = getattr(state.knobs, "tune_profile_obj", None)
+                if prof is not None:
+                    tune_session = TuningSession.from_profile(
+                        state.knobs, self.size, prof,
+                        profile_path=state.knobs.tune_profile)
+                else:
+                    tune_session = TuningSession(
+                        state.knobs, self.size,
+                        profile_path=state.knobs.tune_profile)
+                state.tune_session = tune_session
+            elif state.knobs.autotune:
                 from .parameter_manager import ParameterManager
                 param_manager = ParameterManager(
                     warmup_samples=state.knobs.autotune_warmup_samples,
@@ -2334,7 +2425,8 @@ class NetworkController(Controller):
                                  else None),
                     log_path=state.knobs.autotune_log)
                 state.parameter_manager = param_manager
-            self.server = self._make_server(state, port, param_manager)
+            self.server = self._make_server(state, port, param_manager,
+                                            tune_session)
             self._publish_actual_addr(addr, self.server.port)
             host = "127.0.0.1"
             self._addr = (host, self.server.port)
@@ -2390,7 +2482,8 @@ class NetworkController(Controller):
         in-stream between executed batches for free."""
         self._on_response = fn
 
-    def _make_server(self, state, port, param_manager):
+    def _make_server(self, state, port, param_manager,
+                     tune_session=None):
         """Prefer the native C++ coordinator (horovod_tpu/native); fall
         back to the Python CoordinatorServer.  The Python server is
         also used when a timeline is active (negotiation spans are
@@ -2416,6 +2509,13 @@ class NetworkController(Controller):
                 "HOROVOD_AUTOTUNE=1: the autotuner requires the Python "
                 "coordinator (in-line scoring + PA parameter frames). "
                 "Unset one of the two.")
+        if strict_native and tune_session is not None:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_TUNE=1: autotune-then-freeze requires the "
+                "Python coordinator (per-class round scoring + PA knob "
+                "frames).  Run the frozen knobs through plain env "
+                "variables instead, or unset one of the two.")
         metrics_interval = state.knobs.metrics_agg_interval_s
         if strict_native and metrics_interval > 0:
             raise RuntimeError(
@@ -2457,6 +2557,7 @@ class NetworkController(Controller):
                 "requires the Python coordinator (relay frames).  "
                 "Unset one of the two.")
         if state.timeline is None and param_manager is None and \
+                tune_session is None and \
                 metrics_interval <= 0 and not _fp.ENABLED and \
                 not selfheal and not tree:
             try:
@@ -2499,7 +2600,8 @@ class NetworkController(Controller):
             reconnect_grace_s=state.knobs.reconnect_grace_s,
             registration_timeout_s=state.knobs.registration_timeout_s,
             fanout=getattr(state.knobs, "coord_fanout", 0),
-            on_rank_lost=self._make_rank_lost_publisher(state))
+            on_rank_lost=self._make_rank_lost_publisher(state),
+            tune_session=tune_session)
 
     def _make_rank_lost_publisher(self, state):
         """Rank-0 hook: publish non-clean rank-lost promotions to the
@@ -3404,12 +3506,23 @@ class NetworkController(Controller):
             pass
         return responses, []
 
+    def set_params_hook(self, fn):
+        """Runtime callback for tuned worker knobs: called with every
+        decoded PA payload, at the frame's in-stream position (see
+        _apply_params)."""
+        self._params_hook = fn
+
     def _apply_params(self, params: dict):
         """Adopt autotuned parameters announced by the coordinator
         (reference: Controller::SynchronizeParameters)."""
         if "hierarchical" in params:
             self.state.knobs.hierarchical_allreduce = \
                 bool(params["hierarchical"])
+        if self._params_hook is not None:
+            # Tuned worker knobs (cycle time, coalescing, replay
+            # warmup) + the tuning_active lifecycle bit that holds or
+            # releases steady-state replay.
+            self._params_hook(params)
 
     def shutdown(self):
         self._closing = True

@@ -44,6 +44,30 @@ HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_STEADY_STATE_REPLAY = "HOROVOD_STEADY_STATE_REPLAY"
 HOROVOD_REPLAY_WARMUP_CYCLES = "HOROVOD_REPLAY_WARMUP_CYCLES"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+# Autotune-then-freeze (horovod_tpu/tune, docs/autotune.md): run an
+# online knob search as replay's warmup phase — per-cycle-class fusion
+# thresholds plus the worker knobs (cycle time, request coalescing,
+# replay warmup) — then FREEZE the winner and let steady-state replay
+# engage on the tuned schedule.  Python-coordinator-only (in-line round
+# scoring + PA knob frames), the same gating as HOROVOD_AUTOTUNE.
+HOROVOD_TUNE = "HOROVOD_TUNE"
+# Tuned-profile artifact path: while tuning, the freeze persists the
+# winning configuration here; at init, an EXISTING valid profile is
+# loaded instead of re-searching (restarts and elastic resizes skip
+# straight to the frozen knobs + replay).
+HOROVOD_TUNE_PROFILE = "HOROVOD_TUNE_PROFILE"
+# Search strategy: "gp" (Gaussian-process Expected Improvement over
+# the continuous knobs, fixed seed) or "grid" (deterministic
+# coordinate descent — what tests and chaos drills pin).
+HOROVOD_TUNE_STRATEGY = "HOROVOD_TUNE_STRATEGY"
+HOROVOD_TUNE_CYCLES_PER_SAMPLE = "HOROVOD_TUNE_CYCLES_PER_SAMPLE"
+HOROVOD_TUNE_MAX_SAMPLES = "HOROVOD_TUNE_MAX_SAMPLES"
+HOROVOD_TUNE_WARMUP_WINDOWS = "HOROVOD_TUNE_WARMUP_WINDOWS"
+# Request coalescing (PR 4): the inline fast path is taken only from
+# an IDLE tensor table, so async bursts drain as one CH/RQ frame per
+# kind.  On by default; the tuner explores both settings (0 = every
+# submission goes inline immediately, one frame per op).
+HOROVOD_REQUEST_COALESCING = "HOROVOD_REQUEST_COALESCING"
 HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
@@ -281,6 +305,24 @@ class Knobs:
     autotune: bool = False
     replay_enabled: bool = True
     replay_warmup_cycles: int = 3
+    # --- autotune-then-freeze (horovod_tpu/tune, docs/autotune.md) ---
+    # tune_profile_loaded is derived, not an env knob: True when a
+    # valid profile at tune_profile was applied onto these knobs, so
+    # the runtime knows tuning is already frozen (replay engages
+    # immediately; the coordinator runs no search).
+    tune: bool = False
+    tune_profile: Optional[str] = None
+    tune_profile_loaded: bool = False
+    # The parsed TunedProfile object when tune_profile_loaded: the
+    # single read of the artifact (knob adoption AND the controller's
+    # pre-frozen session both use it — re-reading the file later could
+    # race a concurrent freeze replacing it).
+    tune_profile_obj: Optional[object] = None
+    tune_strategy: str = "gp"
+    tune_cycles_per_sample: int = 8
+    tune_max_samples: int = 24
+    tune_warmup_windows: int = 2
+    request_coalescing: bool = True
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
@@ -313,12 +355,31 @@ class Knobs:
         if not self.reconnect_grace_s:
             self.reconnect_grace_s = self.liveness_timeout_s
 
+    def apply_tuned_profile(self, profile) -> None:
+        """Adopt a frozen tuned profile (horovod_tpu/tune) onto these
+        knobs: the dense-class fusion threshold plus the worker knobs.
+        Explicit env values are the profile's own starting point (the
+        search anchored there), so profile-wins is the right order.
+        Per-class thresholds for the coordinator come from the profile
+        directly (controller_net builds a frozen session from it)."""
+        dense = profile.fusion_bytes_for("dense")
+        if dense:
+            self.fusion_threshold_bytes = dense
+        w = profile.worker or {}
+        if "cycle_time_ms" in w:
+            self.cycle_time_ms = float(w["cycle_time_ms"])
+        if "coalesce" in w:
+            self.request_coalescing = bool(w["coalesce"])
+        if "replay_warmup" in w:
+            self.replay_warmup_cycles = int(w["replay_warmup"])
+        self.tune_profile_loaded = True
+
     @classmethod
     def from_env(cls) -> "Knobs":
         liveness_interval = env_float(HOROVOD_LIVENESS_INTERVAL, 0.0)
         liveness_timeout = env_float(HOROVOD_LIVENESS_TIMEOUT, 0.0)
         reconnect_grace = env_float(HOROVOD_RECONNECT_GRACE, 0.0)
-        return cls(
+        knobs = cls(
             fusion_threshold_bytes=env_int(
                 HOROVOD_FUSION_THRESHOLD, 64 * 1024 * 1024),
             cycle_time_ms=env_float(HOROVOD_CYCLE_TIME, 1.0),
@@ -329,6 +390,17 @@ class Knobs:
             replay_enabled=env_bool(HOROVOD_STEADY_STATE_REPLAY, True),
             replay_warmup_cycles=env_int(HOROVOD_REPLAY_WARMUP_CYCLES,
                                          3),
+            tune=env_bool(HOROVOD_TUNE),
+            tune_profile=os.environ.get(HOROVOD_TUNE_PROFILE),
+            tune_strategy=os.environ.get(
+                HOROVOD_TUNE_STRATEGY, "gp").strip().lower(),
+            tune_cycles_per_sample=env_int(
+                HOROVOD_TUNE_CYCLES_PER_SAMPLE, 8),
+            tune_max_samples=env_int(HOROVOD_TUNE_MAX_SAMPLES, 24),
+            tune_warmup_windows=env_int(
+                HOROVOD_TUNE_WARMUP_WINDOWS, 2),
+            request_coalescing=env_bool(
+                HOROVOD_REQUEST_COALESCING, True),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG),
             autotune_warmup_samples=env_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
             autotune_steps_per_sample=env_int(
@@ -357,3 +429,14 @@ class Knobs:
                 HOROVOD_REGISTRATION_TIMEOUT, 30.0),
             coord_fanout=max(0, env_int(HOROVOD_COORD_FANOUT, 0)),
         )
+        if knobs.tune_profile:
+            # A valid frozen profile at the path means the search is
+            # already done: adopt its knobs and skip straight to
+            # replay.  A missing/corrupt file means "tune and write it
+            # here" (try_load_profile is deliberately forgiving).
+            from ..tune.profile import try_load_profile
+            prof = try_load_profile(knobs.tune_profile)
+            if prof is not None:
+                knobs.apply_tuned_profile(prof)
+                knobs.tune_profile_obj = prof
+        return knobs

@@ -91,6 +91,10 @@ RELAY_LOST = "relay_lost"        # relay, kind, ranks — RL notice
 REHOME = "rehome"                # hop, outcome — leaf climbed its chain
 # Replay
 REPLAY = "replay"            # phase=enter/exit, reason?, batches?
+# Autotune-then-freeze (horovod_tpu/tune): lifecycle transitions +
+# knob proposals, so a postmortem shows WHICH phase the search was in
+# (and which knobs were live) when a drill killed a rank mid-search.
+TUNE = "tune"                # phase=search/propose/frozen/aborted
 # Checkpoint
 CKPT = "ckpt"                # phase, step, outcome?
 # Elastic

@@ -156,6 +156,11 @@ class ParameterManager:
             best_score = 0.0
         self._apply(self._combos.index(best_combo), best_params)
         self._done = True
+        # Convergence is an announcable event even when the winning
+        # combo is the one already applied: the final PA frame carries
+        # tuning_active=false, which is what releases the steady-state
+        # replay hold on every rank (warmup -> freeze -> replay).
+        self.params_version += 1
         logger.info(
             "autotune converged: fusion=%.1fMB hierarchical=%s cache=%s "
             "(%.1f MB/s)", best_params[0], best_combo[0], best_combo[1],

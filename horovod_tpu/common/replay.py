@@ -63,6 +63,13 @@ very same merged Response objects the CB path built):
   must exercise the negotiated path;
 * shutdown / a broken control plane.
 
+While an autotune-then-freeze search is live (horovod_tpu/tune), the
+tracker additionally HOLDS entry — counted under
+``hvd_steady_state_exits{reason="tuning"}`` — and engages only after
+the freeze/abort announcement releases it (``set_tuning``); tuning and
+replay are phases of one lifecycle, not mutually exclusive modes
+(docs/autotune.md).
+
 Known limitation: a rank joining EARLY (uneven data) cannot signal
 peers mid-replay — their next replayed collective fails with a
 bounded data-plane timeout instead of zero-substituting (see
@@ -179,6 +186,16 @@ class SteadyStateReplay:
         self._pos = 0
         self._batch_reqs: List[Request] = []
         self._disabled_reason: Optional[str] = None
+        # Autotune-then-freeze hold (horovod_tpu/tune): while a tuning
+        # session is searching, knob proposals (PA frames) re-shape
+        # fused batches mid-stream, so a frozen schedule would go
+        # stale the moment the next proposal lands.  The tracker keeps
+        # OBSERVING cycles but refuses entry, counting each suppressed
+        # entry under hvd_steady_state_exits{reason="tuning"}; the
+        # freeze/abort announcement releases the hold (set_tuning) and
+        # replay then engages cleanly on the tuned schedule.  This
+        # replaces the old blanket autotune-disables-replay exclusion.
+        self._tuning = False
 
     # ------------------------------------------------------------------
     # submission-side hooks (called from BackgroundRuntime.submit)
@@ -363,7 +380,19 @@ class SteadyStateReplay:
             # feeds the active-mode exit above.
 
     def on_params(self):
-        self.note_disruption("params")
+        """PA frame observed.  Recv-thread timing, so the inactive
+        case acts through the op-index floor exactly like the
+        non-tracked traffic in on_responses — a full reset here would
+        void cycle N on one rank and N+1 on another (this path was
+        dead before autotune-then-freeze: PA frames used to imply
+        replay was disabled outright, so nothing ever tracked while
+        one arrived)."""
+        with self._lock:
+            if self.active:
+                self._exit_locked("params")
+            else:
+                self._void_before = max(self._void_before,
+                                        self._ops_delivered)
 
     def on_broken(self):
         self.note_disruption("broken")
@@ -371,6 +400,38 @@ class SteadyStateReplay:
     # ------------------------------------------------------------------
     # lifecycle / test controls
     # ------------------------------------------------------------------
+    def set_tuning(self, active: bool):
+        """Hold (True) or release (False) replay entry for the tuning
+        lifecycle.  The release arrives as a PA frame — ordered in
+        the broadcast stream but PROCESSED at recv-thread timing — so
+        it must never reset tracking directly (which cycle is current
+        differs per rank); it acts through the op-index floor instead:
+        the post-freeze convergence window is required to start at or
+        after the release's stream position, identical on every rank,
+        and entry under the tuned knobs happens at the same cycle
+        boundary everywhere.  The hold itself is armed before any
+        traffic (runtime init), where a reset is position-free."""
+        with self._lock:
+            if bool(active) == self._tuning:
+                return
+            self._tuning = bool(active)
+            if self.active:
+                # Entry raced the announcement on another thread; the
+                # exit flushes any partial batch back to negotiation.
+                self._exit_locked("tuning")
+            elif active:
+                self._reset_tracking_locked()
+            else:
+                self._void_before = max(self._void_before,
+                                        self._ops_delivered)
+
+    def set_warmup(self, cycles: int):
+        """Adopt a tuned replay-warmup knob (takes effect at the next
+        convergence streak; announced via PA, so identical on every
+        rank at the same stream position)."""
+        with self._lock:
+            self.warmup = max(1, int(cycles))
+
     def set_enabled(self, flag: bool):
         """Runtime toggle (bench lanes measure the negotiated floor by
         disabling replay, then re-enable it for the replay floor)."""
@@ -389,6 +450,7 @@ class SteadyStateReplay:
             return {"active": self.active,
                     "stable_cycles": self._stable,
                     "schedule_batches": len(self._schedule),
+                    "tuning_hold": self._tuning,
                     "disabled_reason": self._disabled_reason}
 
     # ------------------------------------------------------------------
@@ -444,15 +506,43 @@ class SteadyStateReplay:
         shape = (tuple(k for k, _ in cycle),
                  tuple(s for _, s in cycle),
                  tuple(len(keys) for _, keys, _, _ in delivered))
-        if shape == self._prev_cycle and self._stable > 0:
+        if shape == self._prev_cycle and self._stable > 0 and \
+                self._window_start >= self._void_before:
             self._stable += 1
         else:
+            # Streak (re)starts here — including a continuing streak
+            # whose window began below the floor (a disruption or
+            # tuning release landed mid-streak): restarting at CLOSE
+            # time keeps the anchor a pure function of content-
+            # deterministic indices, so every rank restarts at the
+            # same cycle no matter when its recv thread processed the
+            # disrupting frame.
             self._prev_cycle = shape
             self._stable = 1
             self._window_start = start
         self._last_delivered = delivered
 
     def _try_enter_locked(self) -> bool:
+        if self._tuning:
+            # A tuning search is live: refuse entry, touching NO
+            # tracking state — the release (a PA frame) lands at
+            # recv-thread timing, so one rank may evaluate this
+            # boundary held while a peer evaluates it released; both
+            # must leave identical state behind (the released peer is
+            # refused by the floor check below, which the release
+            # raised) or their streaks diverge and one rank replays
+            # while the other negotiates: a wedge (measured, not
+            # hypothetical).  The label fires once per streak (stable
+            # passes warmup exactly once while held, since nothing
+            # resets it) so dashboards can tell "replay waiting on
+            # the tuner" from a genuinely diverged workload.
+            if self._stable == self.warmup:
+                _EXITS.inc(1, reason="tuning")
+                if _fr.ENABLED:
+                    _fr.record(_fr.REPLAY,
+                               rank=self.runtime.state.rank_info.rank,
+                               phase="held", reason="tuning")
+            return False
         if _fp.ENABLED:
             # Armed failpoints pin the negotiated path (fault
             # schedules target the wire sites replay bypasses).
@@ -471,9 +561,13 @@ class SteadyStateReplay:
             # and the submitter blocks on the streak's final response,
             # so every frame preceding that response — anywhere a
             # disruption could hide — has been applied to
-            # _void_before by the time entry is evaluated.
-            self._stable = 0
-            self._prev_cycle = None
+            # _void_before by the time entry is evaluated.  Pure
+            # refusal, no state wipe: the NEXT cycle close restarts
+            # the streak through the same window-vs-floor comparison
+            # (_close_cycle_locked), at the same content-deterministic
+            # position on every rank — wiping here would interleave
+            # with the recv-timed tuning-hold check above and anchor
+            # different ranks at different cycles.
             return False
         # Signatures are taken POSITIONALLY from the converged cycle:
         # _close_cycle_locked proved the delivered keys equal the

@@ -99,16 +99,40 @@ class BackgroundRuntime:
             self.controller.set_receive_callback(self._wake.set)
         # Steady-state replay (common/replay.py): negotiation-free
         # execution of converged cycles.  Networked worlds only (a
-        # loopback world has no round-trip to skip); autotune runs are
-        # excluded — PA frames re-knob fusion mid-stream, which replay
-        # would freeze past.
+        # loopback world has no round-trip to skip).  Autotune no
+        # longer disables replay outright: while a tuning search is
+        # live (HOROVOD_TUNE / HOROVOD_AUTOTUNE, not yet frozen) the
+        # tracker is HELD — it observes but refuses entry, labeled
+        # hvd_steady_state_exits{reason="tuning"} — and the
+        # freeze/convergence PA announcement releases it, so the
+        # lifecycle is warmup -> freeze -> replay (docs/autotune.md).
+        # A reloaded tuned profile means the search already ran:
+        # replay is free from the first cycle.
         self.replay: Optional[SteadyStateReplay] = None
-        if self._inline and state.knobs.replay_enabled and \
-                not state.knobs.autotune:
+        # Worker-side tuning lifecycle bit, tracked on the runtime
+        # itself (not only via the replay tracker — which may not
+        # exist, e.g. HOROVOD_STEADY_STATE_REPLAY=0): flipped by the
+        # tuning_active field of PA announcements; read by
+        # hvd.tune_status().
+        self.tuning_active = (state.knobs.tune or
+                              state.knobs.autotune) and \
+            not state.knobs.tune_profile_loaded
+        if self._inline and state.knobs.replay_enabled:
             self.replay = SteadyStateReplay(
                 self, warmup_cycles=state.knobs.replay_warmup_cycles)
+            if self.tuning_active:
+                self.replay.set_tuning(True)
             if hasattr(self.controller, "set_replay_observer"):
                 self.controller.set_replay_observer(self.replay)
+        # Request coalescing (tunable): when on (default), the inline
+        # fast path is taken only from an IDLE table so async bursts
+        # drain as one coalesced CH/RQ frame per kind; off = every
+        # eligible submission goes inline immediately (one frame per
+        # op — lower latency for strictly synchronous loops, more
+        # frames for bursty ones).  The tuner explores both.
+        self._coalesce = state.knobs.request_coalescing
+        if hasattr(self.controller, "set_params_hook"):
+            self.controller.set_params_hook(self._apply_tuned_params)
         self._thread: Optional[threading.Thread] = None
         self._cycle_time_s = state.knobs.cycle_time_ms / 1000.0
         self._entry_sizes: Dict[tuple, int] = {}  # (psid, name)
@@ -144,6 +168,28 @@ class BackgroundRuntime:
         """Wake the background cycle (replay exit flushes its partial
         batch into the negotiation queue and needs a cycle now)."""
         self._wake.set()
+
+    def _apply_tuned_params(self, params: dict):
+        """Adopt tuned worker knobs announced through a PA frame
+        (horovod_tpu/tune).  Runs at the frame's position in the
+        response stream — identical on every rank — so no two ranks
+        ever run different knobs for the same cycle."""
+        knobs = self.state.knobs
+        if "cycle_time_ms" in params:
+            knobs.cycle_time_ms = float(params["cycle_time_ms"])
+            self._cycle_time_s = knobs.cycle_time_ms / 1000.0
+        if "coalesce" in params:
+            self._coalesce = bool(params["coalesce"])
+            knobs.request_coalescing = self._coalesce
+        if "tuning_active" in params:
+            self.tuning_active = bool(params["tuning_active"])
+        replay = self.replay
+        if replay is not None:
+            if "replay_warmup" in params:
+                knobs.replay_warmup_cycles = int(params["replay_warmup"])
+                replay.set_warmup(knobs.replay_warmup_cycles)
+            if "tuning_active" in params:
+                replay.set_tuning(bool(params["tuning_active"]))
 
     def _make_controller(self):
         if self.state.rank_info.size == 1:
@@ -205,7 +251,8 @@ class BackgroundRuntime:
         # (r05 measured one RQ frame per tensor).  Synchronous loops
         # always see an idle table, so the tiny-op floor is unchanged.
         if self._inline and request.group_id < 0 and not self._joined \
-                and self.tensor_queue.outstanding() == 0:
+                and (not self._coalesce or
+                     self.tensor_queue.outstanding() == 0):
             # Inline cache-hit fast path: entry lands in the table
             # FIRST (the recv thread may dispatch the response
             # immediately), then the CH frame goes out on THIS thread

@@ -29,6 +29,12 @@ _ENV_MAP = {
         ("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", str),
     "autotune_gaussian_process_noise":
         ("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", str),
+    "tune": ("HOROVOD_TUNE", lambda v: "1" if v else "0"),
+    "tune_profile": ("HOROVOD_TUNE_PROFILE", str),
+    "tune_strategy": ("HOROVOD_TUNE_STRATEGY", str),
+    "tune_cycles_per_sample": ("HOROVOD_TUNE_CYCLES_PER_SAMPLE", str),
+    "tune_max_samples": ("HOROVOD_TUNE_MAX_SAMPLES", str),
+    "tune_warmup_windows": ("HOROVOD_TUNE_WARMUP_WINDOWS", str),
     "timeline_filename": ("HOROVOD_TIMELINE", str),
     "timeline_mark_cycles": ("HOROVOD_TIMELINE_MARK_CYCLES",
                              lambda v: "1" if v else "0"),
@@ -59,6 +65,14 @@ _CONFIG_SECTIONS = {
         "steps_per_sample": "autotune_steps_per_sample",
         "bayes_opt_max_samples": "autotune_bayes_opt_max_samples",
         "gaussian_process_noise": "autotune_gaussian_process_noise",
+    },
+    "tune": {
+        "enabled": "tune",
+        "profile": "tune_profile",
+        "strategy": "tune_strategy",
+        "cycles_per_sample": "tune_cycles_per_sample",
+        "max_samples": "tune_max_samples",
+        "warmup_windows": "tune_warmup_windows",
     },
     "timeline": {
         "filename": "timeline_filename",

@@ -156,6 +156,36 @@ def parse_args(argv=None):
     group_at.add_argument("--autotune-gaussian-process-noise", type=float,
                           action=make_override_action(override_args))
 
+    group_tn = parser.add_argument_group("autotune-then-freeze arguments")
+    tn_en = group_tn.add_mutually_exclusive_group()
+    tn_en.add_argument("--tune", dest="tune",
+                       action=make_override_bool_action(override_args,
+                                                        True),
+                       help="Online knob search (per-cycle-class fusion "
+                            "+ worker knobs) that freezes into a tuned "
+                            "profile, then hands the schedule to "
+                            "steady-state replay (docs/autotune.md).")
+    tn_en.add_argument("--no-tune", dest="tune",
+                       action=make_override_bool_action(override_args,
+                                                        False))
+    group_tn.add_argument("--tune-profile", dest="tune_profile",
+                          action=make_override_action(override_args),
+                          help="Tuned-profile artifact path: written at "
+                               "freeze; an existing valid profile skips "
+                               "the re-search on restart.")
+    group_tn.add_argument("--tune-strategy", dest="tune_strategy",
+                          choices=["gp", "grid"],
+                          action=make_override_action(override_args),
+                          help="gp = Gaussian-process EI (default); "
+                               "grid = deterministic coordinate "
+                               "descent.")
+    group_tn.add_argument("--tune-cycles-per-sample", type=int,
+                          action=make_override_action(override_args))
+    group_tn.add_argument("--tune-max-samples", type=int,
+                          action=make_override_action(override_args))
+    group_tn.add_argument("--tune-warmup-windows", type=int,
+                          action=make_override_action(override_args))
+
     group_el = parser.add_argument_group("elastic arguments")
     group_el.add_argument("--min-np", dest="min_np", type=int,
                           help="Minimum processes for elastic runs.")

@@ -221,12 +221,15 @@ def soak_knobs(stall_shutdown_s: float,
                liveness_interval_s: float = 0.0,
                liveness_timeout_s: float = 0.0,
                reconnect_grace_s: float = 0.0,
-               coord_fanout: int = 0) -> Knobs:
+               coord_fanout: int = 0,
+               tune: bool = False) -> Knobs:
     """Robustness machinery tightened to soak time scales: a dropped
     frame must surface through stall shutdown in seconds, not the
     production 60s.  MTTR/liveness drills additionally arm HB
     heartbeats + the reconnect grace window at sub-second cadence;
-    relay drills arm the fan-out tree."""
+    relay drills arm the fan-out tree; the tune drill arms the
+    autotune-then-freeze session at drill-scale window sizes with the
+    deterministic grid strategy."""
     return Knobs(
         cache_capacity=1024,
         cycle_time_ms=1.0,
@@ -238,6 +241,11 @@ def soak_knobs(stall_shutdown_s: float,
         liveness_timeout_s=liveness_timeout_s,
         reconnect_grace_s=reconnect_grace_s,
         coord_fanout=coord_fanout,
+        tune=tune,
+        tune_strategy="grid",
+        tune_cycles_per_sample=2,
+        tune_warmup_windows=1,
+        tune_max_samples=30,
     )
 
 
@@ -249,7 +257,8 @@ class ChaosWorld:
                  exchange_timeout_s: float = 8.0,
                  liveness_interval_s: float = 0.0,
                  reconnect_grace_s: float = 0.0,
-                 fanout: int = 0):
+                 fanout: int = 0,
+                 tune: bool = False):
         from horovod_tpu.common import relay as relay_mod
         from horovod_tpu.common.runtime import BackgroundRuntime
 
@@ -279,7 +288,8 @@ class ChaosWorld:
         knobs = soak_knobs(stall_shutdown_s,
                            liveness_interval_s=liveness_interval_s,
                            reconnect_grace_s=reconnect_grace_s,
-                           coord_fanout=fanout)
+                           coord_fanout=fanout,
+                           tune=tune)
         self.runtimes = []
         try:
             # rank 0 first: it hosts the coordinator ...
@@ -896,6 +906,166 @@ def run_replay_kill_drill(ranks: int = 8, seed: int = 0,
                                if recovery_latency is not None
                                else None),
         "recovery_error": recovery_error,
+        "ok": ok,
+        "elapsed_s": round(time.monotonic() - t_start, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# tune-abort drill (autotune-then-freeze, horovod_tpu/tune)
+# ---------------------------------------------------------------------------
+
+def run_tune_kill_drill(mode: str = "kill", ranks: int = 4,
+                        seed: int = 0, max_ops: int = 400,
+                        hang_timeout_s: float = 20.0,
+                        stall_shutdown_s: float = 2.0) -> dict:
+    """Interrupt an autotune-then-freeze search mid-flight and assert
+    it fails SAFE: the session must abort cleanly back to default
+    knobs — one atomic PA announcement, so no knob proposal is ever
+    half-applied across ranks — and the armed flight recorder's
+    postmortem must carry the tune-phase events (search/propose/abort)
+    so a human can see which phase the search was in when the fault
+    hit.
+
+    ``mode="kill"``: a seeded victim rank dies mid-search (after the
+    session has scored at least one proposal); the coordinator's
+    rank-lost path aborts the session (abort_reason="rank_lost") and
+    the verdict must name the victim.
+    ``mode="failpoint"``: the ``tune.propose`` failpoint fires an
+    injected error at the proposal seam; the session must abort with
+    abort_reason="failpoint" with every rank alive and the world
+    still computing correct results."""
+    t_start = time.monotonic()
+    failpoints.reset()
+    rng = random.Random("%d|tune-%s" % (seed, mode))
+    victim = rng.randrange(1, ranks) if mode == "kill" else None
+    bb_dir = _arm_blackbox()
+    if mode == "failpoint":
+        failpoints.configure("tune.propose=error(tune-drill,times=1)",
+                             seed=seed)
+    failures, hangs, incorrect = [], [], []
+    record_lock = threading.Lock()
+    mid_search = threading.Event()   # >=1 proposal scored
+    stop = threading.Event()
+    # Liveness armed (MTTR-drill cadence): bounded kill detection AND
+    # the HB round-trips blackbox_merge aligns per-rank clocks from.
+    world = ChaosWorld(ranks, stall_shutdown_s=stall_shutdown_s,
+                       exchange_timeout_s=2 * stall_shutdown_s,
+                       liveness_interval_s=0.4, tune=True)
+    session = world.runtimes[0].controller.server.tune_session
+
+    def rank_loop(rank: int):
+        for i in range(max_ops):
+            if stop.is_set() and mode == "kill":
+                return
+            if rank == victim and mid_search.is_set():
+                with record_lock:
+                    failures.append({"t": time.monotonic(),
+                                     "rank": rank, "op": i,
+                                     "error": "harness kill",
+                                     "crashed": True})
+                flight_recorder.note("drill.fault", rank=rank)
+                world.kill_rank(rank)
+                return
+            try:
+                out = world.collective(
+                    rank, "allreduce", "tune.%d" % (i % 2),
+                    np.full((65,), _rank_value(rank, i), np.float32),
+                    i, hang_timeout_s)
+                expected = _expected_allreduce((65,), i, ranks)
+                if not np.allclose(out, expected, rtol=1e-5):
+                    with record_lock:
+                        incorrect.append({"rank": rank, "op": i})
+                    stop.set()
+                    return
+            except HangError as e:
+                with record_lock:
+                    hangs.append({"rank": rank, "op": i,
+                                  "error": str(e)})
+                stop.set()
+                return
+            except Exception as e:
+                # Expected on survivors after a kill: SimExchanger
+                # timeout or the coordinator's membership-broken ERROR.
+                with record_lock:
+                    failures.append({"t": time.monotonic(),
+                                     "rank": rank, "op": i,
+                                     "error": repr(e)[:300]})
+                return
+            st = session.status()
+            if not mid_search.is_set() and \
+                    st["classes"]["dense"]["samples"] >= 1 and \
+                    st["phase"] == "search":
+                mid_search.set()
+            if session.finished and mode == "failpoint" and i >= 8:
+                stop.set()
+                return
+        stop.set()
+
+    try:
+        threads = [threading.Thread(target=rank_loop, args=(r,),
+                                    name="tune-drill-r%d" % r,
+                                    daemon=True)
+                   for r in range(ranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max_ops * 1.0 + 2 * hang_timeout_s)
+            if t.is_alive():
+                hangs.append({"rank": t.name, "op": None,
+                              "error": "rank thread never exited"})
+        status = session.status()
+        # "No half-applied knob split": every surviving runtime must
+        # hold the IDENTICAL worker-knob tuple after the abort PA —
+        # drained here with a bounded wait (the abort frame is in
+        # flight when the survivors' loops unwind).
+        expect_reason = "rank_lost" if mode == "kill" else "failpoint"
+        survivors = [r for r in range(ranks) if r != victim]
+        deadline = time.monotonic() + 5.0
+        knob_tuples = []
+        while time.monotonic() < deadline:
+            knob_tuples = [
+                (world.runtimes[r]._cycle_time_s,
+                 world.runtimes[r]._coalesce,
+                 world.runtimes[r].replay.warmup
+                 if world.runtimes[r].replay is not None else None)
+                for r in survivors]
+            if len(set(knob_tuples)) == 1 and \
+                    status["phase"] == "aborted":
+                break
+            time.sleep(0.05)
+            status = session.status()
+        knobs_consistent = len(set(knob_tuples)) == 1
+        postmortem = collect_postmortem(
+            bb_dir, expect_rank=victim if mode == "kill" else None)
+        tune_events = [e for e in flight_recorder.events()
+                       if e[2] == flight_recorder.TUNE]
+        tune_phases = [e[4].get("phase") for e in tune_events]
+    finally:
+        world.close()
+        failpoints.reset()
+        flight_recorder.reset()
+    ok = (not hangs and not incorrect
+          and status["phase"] == "aborted"
+          and status["abort_reason"] == expect_reason
+          and knobs_consistent
+          and "search" in tune_phases
+          and "aborted" in tune_phases
+          and bool(postmortem.get("ok"))
+          and (mode != "kill" or bool(failures)))
+    return {
+        "kind": "tune_kill_drill", "mode": mode, "ranks": ranks,
+        "seed": seed, "victim": victim,
+        "phase": status["phase"],
+        "abort_reason": status["abort_reason"],
+        "dense_samples": status["classes"]["dense"]["samples"],
+        "knobs_consistent": knobs_consistent,
+        "tune_phases_recorded": sorted(set(p for p in tune_phases
+                                           if p)),
+        "postmortem": postmortem,
+        "failures": [{k: v for k, v in f.items() if k != "t"}
+                     for f in failures],
+        "hangs": hangs, "incorrect": incorrect,
         "ok": ok,
         "elapsed_s": round(time.monotonic() - t_start, 3),
     }
@@ -2306,12 +2476,37 @@ def main(argv=None) -> int:
     parser.add_argument("--fanout", type=int, default=None,
                         help="relay arity (default: 2 for --relay, "
                              "8 for --relay-scale)")
+    parser.add_argument("--tune-drill", action="store_true",
+                        help="run the autotune-then-freeze abort "
+                             "drills (rank killed mid-search + "
+                             "tune.propose failpoint) instead of the "
+                             "fault-schedule soak")
     parser.add_argument("--out", default=None,
                         help="write the JSON artifact here")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING)
+    if args.tune_drill:
+        report = {
+            "kill": run_tune_kill_drill(mode="kill",
+                                        ranks=args.ranks,
+                                        seed=args.seed),
+            "failpoint": run_tune_kill_drill(mode="failpoint",
+                                             ranks=args.ranks,
+                                             seed=args.seed),
+        }
+        report["ok"] = all(r["ok"] for r in report.values())
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        summary = {m: {k: r.get(k) for k in
+                       ("phase", "abort_reason", "knobs_consistent",
+                        "ok")}
+                   for m, r in report.items() if isinstance(r, dict)}
+        summary["ok"] = report["ok"]
+        print("CHAOSJSON " + json.dumps(summary))
+        return 0 if report["ok"] else 1
     if args.relay:
         report = run_relay_matrix(ranks=args.ranks,
                                   fanout=args.fanout or 2,
