@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Callable, Optional, Union
 
+from ..common import env as env_mod
 from .coordinator import CommitCoordinator
 from .manager import CheckpointManager, CheckpointNotFoundError
 
@@ -112,11 +113,7 @@ class DurableCheckpointer:
         (``HOROVOD_CKPT_LATEST``, exported by the worker rendezvous
         from the driver's startup disk scan), or None outside a
         launcher-managed restart."""
-        raw = os.environ.get("HOROVOD_CKPT_LATEST")
-        try:
-            return int(raw) if raw else None
-        except ValueError:
-            return None
+        return env_mod.env_int_opt("HOROVOD_CKPT_LATEST")
 
     def maybe_restore(self) -> Optional[int]:
         """Load the newest valid committed checkpoint into the state
@@ -224,12 +221,14 @@ def from_env(state, rank=0, world_size=1, coordinator=None,
     None when durable checkpointing is not configured.  ``directory``
     (and any explicit ``overrides``) beat the env values — the single
     parser every binding-level convenience delegates to."""
-    directory = directory or os.environ.get(ENV_DIR)
+    directory = directory or env_mod.env_str_opt(ENV_DIR)
     if not directory:
         return None
-    overrides.setdefault("keep", int(os.environ.get(ENV_KEEP, "3") or 3))
+    # env_int already defaults on unset/empty/garbage; no `or` fallback
+    # — an EXPLICIT HOROVOD_CHECKPOINT_KEEP=0 means keep nothing.
+    overrides.setdefault("keep", env_mod.env_int(ENV_KEEP, 3))
     overrides.setdefault(
-        "every_n_commits", int(os.environ.get(ENV_EVERY, "1") or 1))
+        "every_n_commits", env_mod.env_int(ENV_EVERY, 1))
     return DurableCheckpointer(
         state, directory, rank=rank, world_size=world_size,
         coordinator=coordinator,

@@ -126,7 +126,7 @@ def _maybe_init_jax_distributed(info: RankInfo):
     """
     import jax
 
-    coordinator = os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR)
+    coordinator = env_mod.env_str_opt(env_mod.HOROVOD_TPU_COORDINATOR)
     if coordinator is None:
         return False
     # Must not touch the backend (jax.devices/process_count) before
@@ -139,8 +139,8 @@ def _maybe_init_jax_distributed(info: RankInfo):
         already = False
     if already:
         return False
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
-            os.environ.get("HOROVOD_TPU_FORCE_CPU"):
+    if env_mod.env_str("JAX_PLATFORMS").startswith("cpu") or \
+            env_mod.env_str_opt("HOROVOD_TPU_FORCE_CPU"):
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.config.update("jax_platforms", "cpu")
     if _state().knobs.elastic:
@@ -164,7 +164,7 @@ def _maybe_init_jax_distributed(info: RankInfo):
             # elastic tests skip on such jax versions; see
             # jax_peer_death_recoverable() in tests/test_elastic_run.py.
             pass
-    heartbeat = os.environ.get("HOROVOD_JAX_HEARTBEAT_TIMEOUT")
+    heartbeat = env_mod.env_str_opt("HOROVOD_JAX_HEARTBEAT_TIMEOUT")
     kwargs = {}
     if heartbeat:
         kwargs["heartbeat_timeout_seconds"] = int(heartbeat)
@@ -198,8 +198,13 @@ def init(comm=None, process_sets=None):
                         add_process_set(ps)
             return
         state.knobs = Knobs.from_env()
+        # Opt-in lock-order witness (docs/static_analysis.md): arm
+        # BEFORE any control-plane object constructs its locks so the
+        # whole incarnation's acquisition graph is recorded.
+        from . import lockwitness as _lw
+        _lw.maybe_enable_from_env()
         if state.knobs.elastic and \
-                os.environ.get(env_mod.HOROVOD_RENDEZVOUS_ADDR):
+                env_mod.env_str_opt(env_mod.HOROVOD_RENDEZVOUS_ADDR):
             # Elastic worker: rank identity comes from the driver's
             # rendezvous, fresh every epoch (reference:
             # gloo/gloo_context.cc:154-200 elastic rank re-query).
@@ -223,9 +228,10 @@ def init(comm=None, process_sets=None):
                     state.rank_info.size = len(ranks)
 
         if state.rank_info.size > 1 and \
-                os.environ.get(env_mod.HOROVOD_TPU_COORDINATOR) is None \
-                and os.environ.get("HOROVOD_RANK0_ADDR") and \
-                os.environ.get(env_mod.HOROVOD_RENDEZVOUS_ADDR):
+                env_mod.env_str_opt(
+                    env_mod.HOROVOD_TPU_COORDINATOR) is None \
+                and env_mod.env_str_opt("HOROVOD_RANK0_ADDR") and \
+                env_mod.env_str_opt(env_mod.HOROVOD_RENDEZVOUS_ADDR):
             # Static launch with a remote rank 0: the launcher could
             # not pick valid ports for rank 0's host, so rank 0 picks
             # them here and publishes via the rendezvous KV
@@ -233,11 +239,12 @@ def init(comm=None, process_sets=None):
             from ..runner.endpoints import STATIC_KEY, resolve_endpoints
             from ..runner.http_server import RendezvousClient
             client = RendezvousClient(
-                os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR],
-                int(os.environ[env_mod.HOROVOD_RENDEZVOUS_PORT]))
+                env_mod.env_require(env_mod.HOROVOD_RENDEZVOUS_ADDR),
+                int(env_mod.env_require(
+                    env_mod.HOROVOD_RENDEZVOUS_PORT)))
             eps = resolve_endpoints(
                 client, state.rank_info.rank,
-                os.environ["HOROVOD_RANK0_ADDR"], STATIC_KEY,
+                env_mod.env_require("HOROVOD_RANK0_ADDR"), STATIC_KEY,
                 timeout=env_mod.start_timeout())
             os.environ[env_mod.HOROVOD_TPU_COORDINATOR] = \
                 eps["coordinator"]

@@ -18,6 +18,7 @@ Deltas from the reference:
   * transport is plain length-prefixed TCP (no MPI/gloo dependency) —
     the launcher provides HOROVOD_CONTROLLER_ADDR.
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 import json
 import logging
@@ -162,6 +163,8 @@ def _recv_exact_bounded(sock: socket.socket, n: int, on_idle,
     buf = b""
     while len(buf) < n:
         try:
+            # hvdlint: bounded-by(caller arms a poll settimeout; every
+            # expiry raises through on_idle)
             chunk = sock.recv(n - len(buf))
         except socket.timeout:
             on_idle()
@@ -433,6 +436,16 @@ class CoordinatorServer:
             if frame is None:
                 conn.close()
                 continue
+            if frame[0] != _MAGIC_REQ:
+                # frame-parity: the only first frame a link may send
+                # is an RQ registration.  Anything else is a garbage /
+                # misdirected client — drop the connection, never
+                # guess a rank out of arbitrary bytes.
+                logger.warning("refusing connection whose first frame "
+                               "is %r (want RQ registration)",
+                               frame[0])
+                conn.close()
+                continue
             rank, sess = _parse_registration(frame[1])
             if relay_mod.is_relay_reg(rank):
                 self._register_relay(
@@ -481,6 +494,8 @@ class CoordinatorServer:
                 old.close()
             except OSError:
                 pass
+        # hvdlint: bounded-by(mux-served link: the selector loop polls
+        # at 0.2s and liveness sweeps cover silent relays)
         conn.settimeout(None)
         logger.info("relay %d link registered (depth_below=%d)", rid,
                     self._relay_depth[rid])
@@ -515,6 +530,7 @@ class CoordinatorServer:
                                  self.liveness_timeout_s)
         if self._tree:
             # Mux-served link: select() gates recv, no poll timeout.
+            # hvdlint: bounded-by(selector loop polls at 0.2s)
             conn.settimeout(None)
         elif self.liveness_interval_s > 0:
             # Bounded registered-link recv: the rank loop polls at a
@@ -522,6 +538,9 @@ class CoordinatorServer:
             # recv forever (the pre-liveness settimeout(None) hole).
             conn.settimeout(self._sweep_period())
         else:
+            # hvdlint: bounded-by(liveness off is the documented
+            # legacy opt-out: the stall inspector is the only clock;
+            # HOROVOD_LIVENESS_INTERVAL>0 bounds this link)
             conn.settimeout(None)
         return self._conn_gen[rank]
 
@@ -2378,7 +2397,7 @@ class NetworkController(Controller):
         self._half_open = False     # harness peer-vanishes analog
         self._hb_stop = threading.Event()
         self._hb_thread = None
-        addr = os.environ.get(CONTROLLER_ADDR_ENV)
+        addr = env_mod.env_str_opt(CONTROLLER_ADDR_ENV)
         if self.rank == 0:
             port = 0
             if addr and ":" in addr:
@@ -2500,9 +2519,7 @@ class NetworkController(Controller):
         # value, a missing/broken native build is an error, not a
         # silent fallback — otherwise native-path tests pass vacuously
         # against the Python coordinator.
-        strict_native = os.environ.get(
-            "HOROVOD_TPU_NATIVE", "").strip().lower() in ("1", "true",
-                                                          "on", "yes")
+        strict_native = env_mod.env_bool("HOROVOD_TPU_NATIVE")
         if strict_native and param_manager is not None:
             raise RuntimeError(
                 "HOROVOD_TPU_NATIVE=1 is incompatible with "
@@ -2650,15 +2667,15 @@ class NetworkController(Controller):
     @staticmethod
     def _rendezvous_client():
         from ..runner.http_server import RendezvousClient
-        addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
-        port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+        addr = env_mod.env_str_opt(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+        port = env_mod.env_str_opt(env_mod.HOROVOD_RENDEZVOUS_PORT)
         if not addr or not port:
             return None
         return RendezvousClient(addr, int(port))
 
     def _ctrl_scope(self) -> str:
         # Per-epoch scope so elastic re-inits don't read a stale addr.
-        epoch = os.environ.get("HOROVOD_CONTROLLER_ADDR", "")
+        epoch = env_mod.env_str(CONTROLLER_ADDR_ENV, "")
         return f"controller.{epoch}"
 
     def _publish_actual_addr(self, env_addr, actual_port):
@@ -2716,7 +2733,7 @@ class NetworkController(Controller):
         # on its own machine (the launcher's hostname contract names
         # it); env_addr's host is only right for rank 0 — and for
         # single-host runs, where everything shares it.
-        host = os.environ.get(env_mod.HOROVOD_HOSTNAME)
+        host = env_mod.env_str_opt(env_mod.HOROVOD_HOSTNAME)
         if not host:
             host = env_addr.rsplit(":", 1)[0] if env_addr \
                 else "127.0.0.1"
@@ -2813,6 +2830,10 @@ class NetworkController(Controller):
         if self._liveness_interval_s > 0:
             s.settimeout(self._poll_period_s())
         else:
+            # hvdlint: bounded-by(liveness off is the documented
+            # legacy opt-out: a wedged coordinator is then caught only
+            # by the stall inspector; HOROVOD_LIVENESS_INTERVAL>0
+            # arms the poll timeout above)
             s.settimeout(None)
 
     def _connect(self) -> socket.socket:
@@ -3251,13 +3272,23 @@ class NetworkController(Controller):
                     if self._on_receive is not None:
                         self._on_receive()
                 continue
-            self.stats["rs_frames"] += 1
-            responses, _ = unpack_response_list(payload)
-            self._seed_cache(responses)
-            if self._replay_observer is not None:
-                self._replay_observer.on_responses(
-                    "rs", [(r, ()) for r in responses])
-            self._deliver(responses)
+            if magic == _MAGIC_RESP:
+                self.stats["rs_frames"] += 1
+                responses, _ = unpack_response_list(payload)
+                self._seed_cache(responses)
+                if self._replay_observer is not None:
+                    self._replay_observer.on_responses(
+                        "rs", [(r, ()) for r in responses])
+                self._deliver(responses)
+                continue
+            # frame-parity: an unknown kind used to fall through into
+            # unpack_response_list, where a garbage payload killed the
+            # recv loop with a struct.error.  Log and drop instead —
+            # the stream cursor already counted it, so resume replay
+            # stays aligned with the coordinator's out-log.
+            logger.warning("rank %d: ignoring unknown downlink frame "
+                           "kind %r (%d bytes)", self.rank, magic,
+                           len(payload))
 
     def _send_frame_counted_locked(self, magic: bytes, payload: bytes,
                                    stat_key: str, kind: str):

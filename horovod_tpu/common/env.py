@@ -255,6 +255,30 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_str(name: str, default: str = "") -> str:
+    v = os.environ.get(name)
+    return v if v is not None else default
+
+
+def env_str_opt(name: str) -> Optional[str]:
+    """Optional string knob: None when unset (callers branch on
+    presence — the tri-state analog of env_bool_opt)."""
+    return os.environ.get(name)
+
+
+def env_require(name: str) -> str:
+    """A contract variable the launcher MUST have provided; a missing
+    one raises KeyError(name) — the same failure mode as the direct
+    ``os.environ[name]`` reads this accessor replaces."""
+    return os.environ[name]
+
+
+def env_set(name: str) -> bool:
+    """Presence test (``name in os.environ``), without exposing the
+    mapping to call sites."""
+    return name in os.environ
+
+
 @dataclasses.dataclass
 class RankInfo:
     """The launcher → worker rank contract, or single-process defaults."""

@@ -70,6 +70,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from . import env as _env
 from . import flight_recorder as _fr
 from . import metrics
 
@@ -125,11 +126,7 @@ def set_rank(rank: Optional[int]):
 def _current_rank() -> Optional[int]:
     if _rank is not None:
         return _rank
-    raw = os.environ.get("HOROVOD_RANK")
-    try:
-        return int(raw) if raw is not None else None
-    except ValueError:
-        return None
+    return _env.env_int_opt(_env.HOROVOD_RANK)
 
 
 def _current_epoch() -> int:
@@ -280,10 +277,7 @@ def configure(spec: str, seed: Optional[int] = None) -> int:
     nothing would defeat the whole point)."""
     global ENABLED, _seed, _rules
     if seed is None:
-        try:
-            seed = int(os.environ.get(ENV_SEED, "0"))
-        except ValueError:
-            seed = 0
+        seed = _env.env_int(ENV_SEED, 0)
     rules: Dict[str, List[_Rule]] = {}
     count = 0
     for part in (spec or "").split(";"):
@@ -376,5 +370,6 @@ def snapshot() -> dict:
 # Arm from the environment at import: the spec rides the launcher env
 # contract to every worker, so a single HOROVOD_FAILPOINTS on the
 # driver arms the whole job.
-if os.environ.get(ENV_SPEC):
-    configure(os.environ[ENV_SPEC])
+_env_spec = _env.env_str_opt(ENV_SPEC)
+if _env_spec:
+    configure(_env_spec)

@@ -65,6 +65,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import env as _env
+
 logger = logging.getLogger("horovod_tpu.blackbox")
 
 ENV_ENABLE = "HOROVOD_BLACKBOX"
@@ -335,12 +337,8 @@ def install_signal_handler():
 # Arm from the environment at import: the knobs ride the launcher env
 # contract to every worker, so one setting on the driver arms the job
 # (the HOROVOD_FAILPOINTS precedent).
-_env_dir = os.environ.get(ENV_DIR)
-_env_on = os.environ.get(ENV_ENABLE, "").strip().lower() in (
-    "1", "true", "yes", "on")
+_env_dir = _env.env_str_opt(ENV_DIR)
+_env_on = _env.env_bool(ENV_ENABLE)
 if _env_dir or _env_on:
-    try:
-        _cap = int(os.environ.get(ENV_CAPACITY, "") or DEFAULT_CAPACITY)
-    except ValueError:
-        _cap = DEFAULT_CAPACITY
+    _cap = _env.env_int(ENV_CAPACITY, DEFAULT_CAPACITY)
     configure(directory=_env_dir, capacity=_cap, enabled=True)

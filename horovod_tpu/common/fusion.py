@@ -12,6 +12,7 @@ plan doubles as the executable-cache key: stable plans mean compile-cache
 hits — which is why deterministic ordering matters even more here than in
 the reference (SURVEY §7 hard parts).
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 from typing import List
 

@@ -34,6 +34,7 @@ relay suppresses its children's idle heartbeats behind a single HB of
 its own (HB/MR/MQ frames are *out-of-stream*: never logged, never
 replayed — see controller_net).
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 import heapq
 import json
@@ -106,7 +107,7 @@ def relay_id_from_reg(rank: int) -> int:
 def relay_addr_map() -> Dict[int, str]:
     """The HOROVOD_RELAY_ADDRS map ({relay_id: "host:port"}), {} when
     unset/unparseable (the KV-published addresses then apply)."""
-    raw = os.environ.get(env_mod.HOROVOD_RELAY_ADDRS)
+    raw = env_mod.env_str_opt(env_mod.HOROVOD_RELAY_ADDRS)
     if not raw:
         return {}
     try:
@@ -410,6 +411,8 @@ class FrameMux:
                 try:
                     # The socket may have been closed by a racing
                     # teardown before we got to register it.
+                    # hvdlint: bounded-by(selector-registered link:
+                    # recv only fires on EVENT_READ, select polls 0.2s)
                     sock.settimeout(None)
                     self._sel.register(sock, selectors.EVENT_READ,
                                        token)
@@ -442,6 +445,8 @@ class FrameMux:
             for key, _ in events:
                 if key.data is None:   # wakeup pipe
                     try:
+                        # hvdlint: bounded-by(EVENT_READ-gated: data
+                        # is already waiting when select returns)
                         self._wake_r.recv(4096)
                     except OSError:
                         pass
@@ -452,6 +457,8 @@ class FrameMux:
                     continue
                 sock, buf = ent
                 try:
+                    # hvdlint: bounded-by(EVENT_READ-gated: data is
+                    # already waiting when select returns)
                     chunk = sock.recv(262144)
                 except OSError:
                     chunk = b""
@@ -515,6 +522,9 @@ def recv_frame(sock: socket.socket):
     def recv_exact(n):
         b = b""
         while len(b) < n:
+            # hvdlint: bounded-by(callers arm settimeout — accept
+            # loops the registration timeout, recv loops the liveness
+            # poll period; socket.timeout propagates to them)
             chunk = sock.recv(n - len(b))
             if not chunk:
                 return None
@@ -739,12 +749,15 @@ class RelayServer:
                 conn.close()
                 continue
             magic, payload = frame
-            if len(payload) < 4:
+            if magic != b"RQ" or len(payload) < 4:
                 # Garbage first frame (port scanner, misdirected
-                # peer): drop the connection, never the accept loop.
+                # peer, wrong kind): drop the connection, never the
+                # accept loop — registration is always an RQ frame.
                 conn.close()
                 continue
             rank = struct.unpack("<i", payload[:4])[0]
+            # hvdlint: bounded-by(registered child moves onto the
+            # selector mux below; select polls at 0.2s)
             conn.settimeout(None)
             if is_relay_reg(rank):
                 token = _ChildToken("relay", relay_id_from_reg(rank),

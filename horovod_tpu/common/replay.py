@@ -91,6 +91,7 @@ plus REPLAY_ENTER / REPLAY_EXIT timeline instants.  Replayed
 submissions are recorded with the local stall inspector exactly like
 negotiated ones, so a rank wedged mid-batch still attributes.
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 import logging
 import threading

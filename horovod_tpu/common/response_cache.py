@@ -35,6 +35,7 @@ signature is unchanged, so the compiled XLA executable for the batch is
 reused without recompilation (SURVEY §7: response-cache hits map to
 executable-cache hits).
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 import threading
 from collections import OrderedDict

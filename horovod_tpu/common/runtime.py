@@ -15,6 +15,7 @@ TPU-specific deltas from the reference:
   * the response cache doubles as the compiled-executable cache key
     (SURVEY §7), so cache hits skip negotiation AND recompilation.
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 import itertools
 import logging

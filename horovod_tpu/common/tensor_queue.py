@@ -6,6 +6,7 @@ mutex-guarded name → TensorTableEntry map + pending Request queue, with
 duplicate-name rejection per common.h:165-168 and a shutdown flush that
 fails every outstanding callback).
 """
+# hvdlint-module: hot-path (instrumentation must hide behind one attribute check — docs/static_analysis.md)
 
 import threading
 from dataclasses import dataclass, field

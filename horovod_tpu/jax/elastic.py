@@ -127,7 +127,7 @@ def durable_checkpointer(state: State, directory: str = None,
     from ..checkpoint.elastic import from_env
 
     factory = None
-    if os.environ.get(env_mod.HOROVOD_RENDEZVOUS_ADDR):
+    if env_mod.env_str_opt(env_mod.HOROVOD_RENDEZVOUS_ADDR):
         from ..runner.elastic.worker import kv_commit_coordinator
         factory = kv_commit_coordinator
 

@@ -31,7 +31,8 @@ _tried = False
 
 
 def _env_enabled() -> bool:
-    return os.environ.get("HOROVOD_TPU_NATIVE", "1").strip().lower() \
+    from ..common import env as env_mod
+    return env_mod.env_str("HOROVOD_TPU_NATIVE", "1").strip().lower() \
         not in ("0", "false", "off", "no")
 
 

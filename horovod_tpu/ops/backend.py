@@ -22,6 +22,8 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import env as env_mod
+
 
 def _is_jax(x) -> bool:
     import jax
@@ -146,7 +148,7 @@ def create_backend(state) -> Backend:
     # HOROVOD_CPU_OPERATIONS=RING|XLA (reference: HOROVOD_CPU_OPERATIONS
     # selecting gloo vs mpi CPU ops, common.h:84-89).
     import jax
-    choice = os.environ.get("HOROVOD_CPU_OPERATIONS", "RING").upper()
+    choice = env_mod.env_str("HOROVOD_CPU_OPERATIONS", "RING").upper()
     if jax.devices()[0].platform == "cpu" and choice == "RING":
         try:
             from .ring_ops import RingBackend

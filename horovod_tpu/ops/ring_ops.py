@@ -23,6 +23,7 @@ from typing import List
 
 import numpy as np
 
+from ..common import env as env_mod
 from ..common import failpoints as _fp
 from ..common import metrics
 from .backend import Backend, even_row_counts
@@ -181,8 +182,8 @@ class RingBackend(Backend):
         incarnation = (f"e{epoch}" if epoch
                        else f"g{getattr(state, 'init_generation', 0)}")
         ns = hashlib.sha1(
-            (os.environ.get("HOROVOD_TPU_COORDINATOR", "") + "|" +
-             os.environ.get("HOROVOD_CONTROLLER_ADDR", "") + "|" +
+            (env_mod.env_str(env_mod.HOROVOD_TPU_COORDINATOR) + "|" +
+             env_mod.env_str("HOROVOD_CONTROLLER_ADDR") + "|" +
              incarnation).encode()
         ).hexdigest()[:12]
         addr_key = f"hvd_ring/{ns}/addr/{{}}"
@@ -230,12 +231,12 @@ class RingBackend(Backend):
             # unanimity round below (a rank writing shm while its
             # neighbor reads TCP would hang the first collective).
             shm_rc, cap = None, 0  # None: disabled / failed locally
-            if rc == 0 and os.environ.get(
+            if rc == 0 and env_mod.env_str(
                     "HOROVOD_RING_SHM", "1").strip().lower() not in (
                     "0", "false", "off", "no"):
+                raw_cap = env_mod.env_str("HOROVOD_RING_SHM_CAP", "")
                 try:
-                    cap = int(os.environ.get("HOROVOD_RING_SHM_CAP",
-                                             str(1 << 20)))
+                    cap = int(raw_cap) if raw_cap else (1 << 20)
                 except ValueError:
                     cap = 0  # bad value: lose the optimization, not
                     #          the rank's marker publish below
@@ -317,8 +318,8 @@ class RingBackend(Backend):
     @staticmethod
     def _my_ip() -> str:
         import socket
-        ctrl = os.environ.get("HOROVOD_CONTROLLER_ADDR") or \
-            os.environ.get("HOROVOD_TPU_COORDINATOR")
+        ctrl = env_mod.env_str_opt("HOROVOD_CONTROLLER_ADDR") or \
+            env_mod.env_str_opt(env_mod.HOROVOD_TPU_COORDINATOR)
         if ctrl and ":" in ctrl:
             host, _, port = ctrl.rpartition(":")
             try:

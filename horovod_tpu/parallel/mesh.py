@@ -25,6 +25,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import env as env_mod
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -75,7 +77,7 @@ def build_mesh(axis_sizes: Optional[Dict[str, int]] = None,
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if not axis_sizes:
-        spec = os.environ.get("HOROVOD_TPU_MESH_AXES")
+        spec = env_mod.env_str_opt(env_mod.HOROVOD_TPU_MESH_AXES)
         axis_sizes = parse_mesh_axes(spec) if spec else {"dp": n}
     names = tuple(axis_sizes.keys())
     shape = _factor(n, list(axis_sizes.values()))

@@ -355,8 +355,8 @@ class ElasticDriver:
         newest committed checkpoint and seed the rendezvous KV's
         ``ckpt/latest`` key — the restart-from-latest-valid path after
         a whole-job preemption, where no rank remembers anything."""
-        import os
-        directory = os.environ.get(ENV_CKPT_DIR)
+        from ...common import env as env_mod
+        directory = env_mod.env_str_opt(ENV_CKPT_DIR)
         if not directory:
             return
         try:

@@ -31,8 +31,8 @@ class HostsRemovedError(SystemExit):
 
 
 def _client() -> RendezvousClient:
-    addr = os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR]
-    port = int(os.environ[env_mod.HOROVOD_RENDEZVOUS_PORT])
+    addr = env_mod.env_require(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = int(env_mod.env_require(env_mod.HOROVOD_RENDEZVOUS_PORT))
     return RendezvousClient(addr, port)
 
 
@@ -62,8 +62,8 @@ def elastic_rendezvous(timeout: Optional[float] = None) -> Dict:
         # to fault a live pod's second epoch.
         _fp.maybe_fail("elastic.rendezvous", epoch=_last_epoch + 1)
     client = _client()
-    hostname = os.environ.get(env_mod.HOROVOD_HOSTNAME, "localhost")
-    local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, "0"))
+    hostname = env_mod.env_str(env_mod.HOROVOD_HOSTNAME, "localhost")
+    local_rank = env_mod.env_int(env_mod.HOROVOD_LOCAL_RANK, 0)
     timeout = timeout or env_mod.start_timeout()
     deadline = time.monotonic() + timeout
     key = f"{hostname}:{local_rank}?last_epoch={_last_epoch}"

@@ -15,6 +15,7 @@ import shlex
 import sys
 from typing import Dict, List, Optional
 
+from ..common import env as env_mod
 from . import safe_shell_exec
 from .hosts import SlotInfo
 from . import job_secret
@@ -43,7 +44,7 @@ def launch_elastic(command: List[str],
                    env: Optional[Dict[str, str]] = None,
                    ) -> Dict[str, int]:
     """Run ``command`` elastically; returns {host:slot: exit_code}."""
-    requested = int(os.environ.get(PREPROVISIONED_PORT_ENV, 0))
+    requested = env_mod.env_int(PREPROVISIONED_PORT_ENV, 0)
     secret = job_secret.for_job(env)
     server = RendezvousServer(verbose, handler_cls=ElasticRendezvousHandler,
                               port=requested, secret=secret)

@@ -19,6 +19,8 @@ import hmac
 import os
 from typing import Optional
 
+from ..common import env as env_mod
+
 ENV = "HOROVOD_SECRET_KEY"
 HEADER = "X-Horovod-Sig"
 TS_HEADER = "X-Horovod-Ts"
@@ -30,7 +32,7 @@ def make_secret_key() -> str:
 
 
 def current() -> Optional[str]:
-    return os.environ.get(ENV) or None
+    return env_mod.env_str_opt(ENV) or None
 
 
 def for_job(env: Optional[dict] = None) -> str:

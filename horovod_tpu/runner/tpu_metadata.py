@@ -14,6 +14,8 @@ import logging
 import os
 from typing import List, Optional
 
+from ..common import env as env_mod
+
 logger = logging.getLogger("horovod_tpu.tpu_metadata")
 
 _METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
@@ -38,7 +40,7 @@ def _metadata_get(key: str, timeout: float = 1.0) -> Optional[str]:
 
 def worker_hostnames() -> List[str]:
     """Hostnames/IPs of all TPU-VM workers of this slice, index-ordered."""
-    env = os.environ.get(TPU_WORKER_HOSTNAMES)
+    env = env_mod.env_str_opt(TPU_WORKER_HOSTNAMES)
     if env:
         return [h.strip() for h in env.split(",") if h.strip()]
     raw = _metadata_get("worker-network-endpoints")
@@ -50,7 +52,7 @@ def worker_hostnames() -> List[str]:
 
 
 def worker_id() -> int:
-    env = os.environ.get(TPU_WORKER_ID)
+    env = env_mod.env_str_opt(TPU_WORKER_ID)
     if env is not None:
         return int(env)
     raw = _metadata_get("agent-worker-number")
@@ -58,7 +60,7 @@ def worker_id() -> int:
 
 
 def accelerator_type() -> Optional[str]:
-    return os.environ.get(TPU_ACCELERATOR_TYPE) or \
+    return env_mod.env_str_opt(TPU_ACCELERATOR_TYPE) or \
         _metadata_get("accelerator-type")
 
 

@@ -148,7 +148,7 @@ def launch_static(command: List[str],
     slots = get_host_assignments(host_infos, np, np)
     rank0_host = slots[0].hostname
 
-    requested = int(os.environ.get(PREPROVISIONED_PORT_ENV, 0))
+    requested = env_mod.env_int(PREPROVISIONED_PORT_ENV, 0)
     # Per-job HMAC key: the server requires it on every request, the
     # env contract hands it to workers (reference secret.py/network.py).
     secret = job_secret.for_job(env)
@@ -271,9 +271,9 @@ def _worker_main():
     """Entry executed by every slot of a ``run(func)`` launch."""
     import cloudpickle
     from .http_server import RendezvousClient
-    addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
-    port = int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"])
-    rank = int(os.environ["HOROVOD_RANK"])
+    addr = env_mod.env_require(env_mod.HOROVOD_RENDEZVOUS_ADDR)
+    port = int(env_mod.env_require(env_mod.HOROVOD_RENDEZVOUS_PORT))
+    rank = int(env_mod.env_require(env_mod.HOROVOD_RANK))
     client = RendezvousClient(addr, port)
     func = cloudpickle.loads(client.wait_get(_FUNC_SCOPE, "func"))
     result = func()
@@ -325,9 +325,9 @@ def run_func(func: Callable, hosts: str, np: int,
 
 if __name__ == "__main__":
     # `python -m horovod_tpu.runner.tpu_run` = run_func worker entry.
-    if "HOROVOD_RUNFUNC_ADDR" in os.environ:
-        os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = \
-            os.environ["HOROVOD_RUNFUNC_ADDR"]
-        os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = \
-            os.environ["HOROVOD_RUNFUNC_PORT"]
+    if env_mod.env_set("HOROVOD_RUNFUNC_ADDR"):
+        os.environ[env_mod.HOROVOD_RENDEZVOUS_ADDR] = \
+            env_mod.env_require("HOROVOD_RUNFUNC_ADDR")
+        os.environ[env_mod.HOROVOD_RENDEZVOUS_PORT] = \
+            env_mod.env_require("HOROVOD_RUNFUNC_PORT")
     _worker_main()

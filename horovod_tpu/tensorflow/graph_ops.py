@@ -34,6 +34,7 @@ import threading
 import tensorflow as tf
 
 from ..common import basics
+from ..common import env as env_mod
 from ..common.basics import (Adasum, Average, Max, Min, Product, Sum,
                              global_process_set)
 
@@ -60,19 +61,18 @@ class _GraphCollectives:
         self._instance_key = 1000
         self._group_keys = {}          # tuple(ranks) -> group key
         self._next_group_key = 2
-        self.timeout = float(os.environ.get(
-            "HOROVOD_TF_COLLECTIVE_TIMEOUT", "0") or 0)
+        self.timeout = env_mod.env_float(
+            "HOROVOD_TF_COLLECTIVE_TIMEOUT", 0.0)
         # Read once: the kill switch participates in the enable vote,
         # so a rank-asymmetric setting degrades every rank to
         # py_function instead of deadlocking graph ranks against
         # py_function ranks.
-        self.env_enabled = os.environ.get(
+        self.env_enabled = env_mod.env_str(
             "HOROVOD_TF_GRAPH_COLLECTIVES", "1").strip().lower() \
             not in ("0", "false", "off")
         # Debug: trace-time key-agreement verification (see key_check).
-        self.key_check_enabled = os.environ.get(
-            "HOROVOD_TF_COLLECTIVE_KEY_CHECK", "").strip().lower() \
-            in ("1", "true", "on")
+        self.key_check_enabled = env_mod.env_bool(
+            "HOROVOD_TF_COLLECTIVE_KEY_CHECK")
         self._check_seq = 0
         self._key_hash = ""
 
@@ -96,9 +96,7 @@ class _GraphCollectives:
         re-points the snapshots).  Read per call, not snapshotted at
         import: programs commonly set the env var from their own CLI
         flags after this module is already imported."""
-        return os.environ.get(
-            "HOROVOD_TF_ELASTIC_GRAPH", "").strip().lower() \
-            in ("1", "true", "on")
+        return env_mod.env_bool("HOROVOD_TF_ELASTIC_GRAPH")
 
     # -- lifecycle -------------------------------------------------------
     def enable(self) -> bool:
@@ -210,7 +208,7 @@ class _GraphCollectives:
 
     @staticmethod
     def _my_ip() -> str:
-        ctrl = os.environ.get("HOROVOD_CONTROLLER_ADDR")
+        ctrl = env_mod.env_str_opt("HOROVOD_CONTROLLER_ADDR")
         if ctrl:
             host, _, port = ctrl.rpartition(":")
             try:

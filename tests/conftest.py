@@ -26,6 +26,23 @@ import pytest
 
 
 @pytest.fixture
+def lock_witness():
+    """Arm the runtime lock-order witness (docs/static_analysis.md)
+    for the duration of a test and FAIL it on any recorded cycle.
+    Used by the chaos smoke and the replay e2e suite — the two lanes
+    that exercise the full multi-threaded control plane in-process."""
+    from horovod_tpu.common import lockwitness as lw
+    lw.reset()
+    lw.enable()
+    try:
+        yield lw
+        lw.assert_no_cycles()
+    finally:
+        lw.disable()
+        lw.reset()
+
+
+@pytest.fixture
 def hvd_single():
     """Initialized single-process horovod_tpu, clean shutdown after."""
     import horovod_tpu as hvd

@@ -17,9 +17,13 @@ from chaos_soak import (BASELINE_SPEC, generate_schedule,  # noqa: E402
 
 
 @pytest.mark.chaos
-def test_chaos_smoke_baseline_8_ranks():
+def test_chaos_smoke_baseline_8_ranks(lock_witness):
     """No-fault control lane: 8 in-process ranks through the real
-    coordinator; every collective completes and reduces correctly."""
+    coordinator; every collective completes and reduces correctly.
+    Runs under the lock-order witness (docs/static_analysis.md): the
+    8-rank world's coordinator/worker/runtime locks are all created
+    and exercised in-process, and the fixture fails the test on any
+    recorded ordering cycle."""
     rec = run_schedule(
         {"index": 0, "spec": BASELINE_SPEC, "seed": 7,
          "kind": "baseline"},
@@ -27,6 +31,10 @@ def test_chaos_smoke_baseline_8_ranks():
     assert rec["outcome"] == "ok", rec
     assert rec["ops_ok"] == [12] * 8
     assert not rec["hangs"] and not rec["incorrect"]
+    # The witness actually saw the world: lock creations and at least
+    # one cross-lock acquisition edge were recorded.
+    assert lock_witness.edge_count() > 0, \
+        "lock witness recorded no acquisition edges — wrapping broke"
 
 
 @pytest.mark.chaos
@@ -91,14 +99,15 @@ def test_chaos_soak_16_ranks():
 
 
 @pytest.mark.chaos
-def test_replay_kill_drill_bounded_recovery_8_ranks():
+def test_replay_kill_drill_bounded_recovery_8_ranks(lock_witness):
     """A rank dying MID-REPLAY (steady-state schedules frozen on every
     rank, zero wire traffic in flight): survivors blocked inside
     replayed collectives must surface bounded errors — never hang —
     and a rebuilt world must verify.  The kill is harness-driven, not
     failpoint-driven: an armed failpoint exits replay by design, so
     this is the one fault the failpoint soaks structurally cannot
-    reach."""
+    reach.  Runs under the lock-order witness: replay enter/exit and
+    the kill/teardown path are the lock-heaviest schedules we have."""
     rec = run_replay_kill_drill(ranks=8, seed=3, hang_timeout_s=20.0,
                                 stall_shutdown_s=2.0)
     assert rec["ok"], {k: rec[k] for k in

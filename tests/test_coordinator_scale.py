@@ -28,7 +28,7 @@ def _connect_ranks(srv, n=NPROC):
     for rank in range(n):
         c = socket.create_connection(("127.0.0.1", srv.port))
         c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_frame(c, b"HI", struct.pack("<i", rank))
+        _send_frame(c, b"RQ", struct.pack("<i", rank))  # registration is an RQ frame (frame-parity rule)
         conns.append(c)
     deadline = time.monotonic() + 10
     while srv.departure_counts()[0] < n and time.monotonic() < deadline:

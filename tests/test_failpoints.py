@@ -213,7 +213,7 @@ def test_coord_broadcast_error_degrades_to_drop():
     try:
         for rank in range(2):
             c = socket.create_connection(("127.0.0.1", srv.port))
-            _send_frame(c, b"HI", struct.pack("<i", rank))
+            _send_frame(c, b"RQ", struct.pack("<i", rank))  # registration is an RQ frame (frame-parity rule)
             conns.append(c)
         deadline = _time.monotonic() + 5
         while srv.departure_counts()[0] < 2 and \

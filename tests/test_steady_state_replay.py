@@ -488,12 +488,21 @@ assert rt.replay.stats()["active"]
 hvd.join()
 assert c("hvd_steady_state_exits").value(reason="join") >= 1
 loop("rp.t0", 2)
+
+# HOROVOD_LOCKWITNESS=1 armed the lock-order witness at init: the
+# whole negotiate/replay/exit lifecycle above ran under it.  Any
+# ABBA ordering between the runtime/controller/replay locks fails
+# here with both sites named (docs/static_analysis.md).
+from horovod_tpu.common import lockwitness as lw
+assert lw.ENABLED and lw.edge_count() > 0, "witness never engaged"
+lw.assert_no_cycles()
 print("REPLAY_E2E_OK", RANK)
 hvd.shutdown()
 """
     results = run_workers(
         body, nproc=2, timeout=180,
-        extra_env={"HOROVOD_STEADY_STATE_REPLAY": "1"})
+        extra_env={"HOROVOD_STEADY_STATE_REPLAY": "1",
+                   "HOROVOD_LOCKWITNESS": "1"})
     assert_all_ok(results)
     for _, out in results:
         assert "REPLAY_E2E_OK" in out
@@ -569,7 +578,10 @@ def _connect_ranks(srv, n=NPROC):
     for rank in range(n):
         c = socket.create_connection(("127.0.0.1", srv.port))
         c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_frame(c, b"HI", struct.pack("<i", rank))
+        # Registration is an RQ frame (frame-parity: the coordinator
+        # refuses any other first kind since hvdlint mechanized the
+        # rule — it used to guess a rank out of arbitrary bytes).
+        _send_frame(c, b"RQ", struct.pack("<i", rank))
         conns.append(c)
     deadline = time.monotonic() + 10
     while srv.departure_counts()[0] < n and \

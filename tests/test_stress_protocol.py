@@ -144,7 +144,7 @@ def test_formation_stall_attributed_and_failed():
         conns = []
         for rank in (0, 1):
             c = socket.create_connection(("127.0.0.1", srv.port))
-            _send_frame(c, b"HI", struct.pack("<i", rank))
+            _send_frame(c, b"RQ", struct.pack("<i", rank))  # registration is an RQ frame (frame-parity rule)
             conns.append(c)
         # Let the hello frames register (accept thread).
         deadline = time.monotonic() + 5

@@ -1,0 +1,3 @@
+# Namespace marker so `python -m tools.hvdlint` resolves from the repo
+# root.  The standalone scripts in this directory are still runnable
+# directly (tests sys.path-insert this directory and import them flat).
