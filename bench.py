@@ -1779,6 +1779,100 @@ def check_coord_scale_regression(out: dict, repo_dir: str):
               file=sys.stderr)
 
 
+def bench_straggler(args, smoke: bool) -> dict:
+    """Time-to-attribution for the live straggler observatory
+    (common/straggler.py): an 8-rank in-process world over the real
+    control plane, one rank delayed via the failpoint grammar
+    (``runtime.submit=delay``), and the lane measures how long the
+    scorer takes to NAME the injected rank — in negotiation mode
+    (arrival-order lag EWMAs) and with steady-state replay engaged
+    (MR-carried phase summaries after the negotiation-era state is
+    wiped).  Each cell also drives ``GET /status`` + ``hvdtop --once``
+    from the live world, so the whole acceptance path is the measured
+    artifact.  The heavier sweep (fanout trees, more reps) stays
+    behind the slow test marker — tier-1 wall budget is near the cap."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from chaos_soak import _percentile, run_straggler_drill
+
+    reps = 2 if smoke else 4
+    out = {"ranks": 8, "delay_ms": 25.0, "victim": 3, "cells": {}}
+    for mode in ("negotiation", "replay"):
+        cells = []
+        for rep in range(reps):
+            cells.append(run_straggler_drill(
+                mode=mode, ranks=8, victim=3, delay_ms=25.0,
+                seed=rep, serve_status=(rep == 0)))
+        ttas = [c["tta_s"] for c in cells
+                if c.get("tta_s") is not None]
+        out["cells"][mode] = {
+            "reps": reps,
+            "all_named": all(c.get("named") for c in cells),
+            "all_ok": all(c.get("ok") for c in cells),
+            "tta_p50_s": round(_percentile(ttas, 50), 3)
+            if ttas else None,
+            "tta_max_s": round(max(ttas), 3) if ttas else None,
+            "victim_score_min": round(min(
+                c["victim_score"] for c in cells), 2),
+            "hvdtop_rc": cells[0].get("hvdtop_rc"),
+        }
+        if mode == "replay":
+            out["cells"][mode]["cycles_replayed_at_named_min"] = min(
+                (c.get("replay") or {}).get(
+                    "cycles_replayed_at_named") or 0 for c in cells)
+    from horovod_tpu.common import metrics as _hm
+    snap = _hm.snapshot()
+    out["metrics"] = {
+        "hvd_ready_spread_seconds": snap.get("histograms", {}).get(
+            "hvd_ready_spread_seconds"),
+        "hvd_critical_path_total": snap.get("counters", {}).get(
+            "hvd_critical_path_total"),
+        "hvd_straggler_flags_total": snap.get("counters", {}).get(
+            "hvd_straggler_flags_total"),
+    }
+    return out
+
+
+def check_straggler_regression(out: dict, repo_dir: str):
+    """Prior-artifact regression warning on time-to-attribution: a
+    big TTA regression means the observatory lost its 'right now'
+    property even though the scorer still names the rank."""
+    cur = out.get("straggler") or {}
+    cells = cur.get("cells") or {}
+    for mode, cell in cells.items():
+        if not cell.get("all_named"):
+            print("WARNING: straggler lane (%s mode) failed to name "
+                  "the injected rank" % mode, file=sys.stderr)
+    # The capture stays INSIDE the negotiation cell's braces: a prior
+    # round whose negotiation cell failed writes tta_p50_s: null, and
+    # a sliding .*? match would then grab the replay cell's number —
+    # comparing across modes.
+    prior = _prior_bench_value(
+        repo_dir,
+        r'"straggler\\?":.*?"negotiation\\?":\s*\{[^{}]*?'
+        r'"tta_p50_s\\?":\s*([0-9.]+)')
+    if prior is None:
+        return  # first round with a (named) straggler lane
+    cur_tta = (cells.get("negotiation") or {}).get("tta_p50_s")
+    if cur_tta is None:
+        return
+    prior_tta, prior_source = prior
+    tol_pct = 100.0  # sub-second measurement on a shared core
+    delta_pct = (cur_tta - prior_tta) / max(prior_tta, 1e-9) * 100.0
+    cur["straggler_vs_prior"] = {
+        "prior_tta_p50_s": prior_tta,
+        "prior_source": prior_source,
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": tol_pct,
+        "regressed": delta_pct > tol_pct,
+    }
+    if cur["straggler_vs_prior"]["regressed"]:
+        print("WARNING: straggler time-to-attribution regressed "
+              "%.1f%% vs %s (%.3fs -> %.3fs), beyond the %.0f%% band"
+              % (delta_pct, prior_source, prior_tta,
+                 cur_tta, tol_pct), file=sys.stderr)
+
+
 def bench_dlrm(args, smoke: bool) -> dict:
     """The recsys/DLRM-tiny lane at 8 CPU worker ranks (ROADMAP open
     item 5): model-parallel sharded embedding tables exchanged through
@@ -2192,7 +2286,7 @@ def main():
                choices=["resnet", "bert", "keras",
                         "collectives", "checkpoint", "scale",
                         "recovery", "dlrm", "coordscale",
-                        "blackbox", "tune"],
+                        "blackbox", "tune", "straggler"],
                    default=None)
     args = p.parse_args()
 
@@ -2248,7 +2342,7 @@ def main():
                                      "collectives", "checkpoint",
                                      "scale", "recovery", "dlrm",
                                      "coordscale", "blackbox",
-                                     "tune"}
+                                     "tune", "straggler"}
 
     resnet = {}
     if "resnet" in run:
@@ -2338,6 +2432,13 @@ def main():
         except Exception as e:
             out["tune"] = {"error": repr(e)[:300]}
         check_tune_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "straggler" in run:
+        try:
+            out["straggler"] = bench_straggler(args, args.smoke)
+        except Exception as e:
+            out["straggler"] = {"error": repr(e)[:300]}
+        check_straggler_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:

@@ -26,7 +26,8 @@ from .common.basics import (Adasum, Average, Max, Min, Product, Sum,
                             mpi_enabled,
                             mpi_threads_supported, nccl_built, num_chips,
                             rank, remove_process_set, shutdown, size,
-                            start_timeline, stop_timeline, cuda_built,
+                            start_timeline, status, stop_timeline,
+                            cuda_built,
                             rocm_built, ccl_built, tune_status,
                             xla_built, xla_enabled)
 
@@ -52,6 +53,7 @@ __all__ = [
     "ccl_built", "xla_built", "xla_enabled",
     "start_timeline", "stop_timeline",
     "metrics_snapshot", "cluster_metrics_snapshot", "tune_status",
+    "status",
     "ProcessSet", "global_process_set", "add_process_set",
     "remove_process_set",
     # ops & op constants

@@ -295,7 +295,8 @@ def init(comm=None, process_sets=None):
             try:
                 state.metrics_server = metrics_mod.serve(
                     port=port,
-                    cluster_provider=cluster_metrics_snapshot)
+                    cluster_provider=cluster_metrics_snapshot,
+                    status_provider=status)
                 logger.info("metrics endpoint on port %d",
                             state.metrics_server.port)
             except (OSError, OverflowError, ValueError):
@@ -521,6 +522,56 @@ def cluster_metrics_snapshot():
     if server is None or not hasattr(server, "merged_metrics"):
         return None
     return server.merged_metrics()
+
+
+def status() -> dict:
+    """The live job-health view (JSON-ready) served at ``GET /status``
+    next to ``/metrics`` — the "which rank is slow RIGHT NOW" plane
+    (docs/observability.md).
+
+    Every rank reports its local view: replay + tune phase, queue
+    depth, op rate, and its own phase-time EWMAs when the straggler
+    observatory (``HOROVOD_STRAGGLER=1``) is armed.  The rank hosting
+    the Python coordinator additionally embeds the ``cluster`` section:
+    per-rank alive/limbo/wedged/lost liveness states, straggler scores
+    and slow flags, and negotiation counters.  ``tools/hvdtop.py``
+    renders this dict live."""
+    from . import metrics as metrics_mod
+    from . import straggler as straggler_mod
+    state = _state()
+    rt = state.runtime
+    out = {
+        "rank": state.rank_info.rank,
+        "size": state.rank_info.size,
+        "initialized": state.initialized,
+        "straggler_armed": straggler_mod.ENABLED,
+    }
+    snap = metrics_mod.snapshot()
+    counters = snap.get("counters", {})
+
+    def _total(name):
+        v = counters.get(name, 0.0)
+        return sum(v.values()) if isinstance(v, dict) else v
+
+    replay = getattr(rt, "replay", None)
+    out["replay"] = {
+        "enabled": bool(state.knobs.replay_enabled),
+        "active": bool(replay is not None and replay.active),
+        "cycles_replayed": _total("hvd_steady_state_cycles_replayed"),
+        "entries": _total("hvd_steady_state_entries"),
+    }
+    out["tune"] = tune_status()
+    if rt is not None:
+        out["queue_depth"] = rt.tensor_queue.outstanding()
+    out["ops_dispatched"] = _total("hvd_responses_dispatched_total")
+    collector = getattr(rt, "phase_collector", None)
+    if straggler_mod.ENABLED and collector is not None:
+        out["phases"] = collector.local_phases()
+    server = getattr(getattr(rt, "controller", None), "server", None)
+    cluster = getattr(server, "status", None)
+    if cluster is not None:
+        out["cluster"] = cluster()
+    return out
 
 
 def tune_status() -> Optional[dict]:

@@ -37,6 +37,7 @@ from . import failpoints as _fp
 from . import flight_recorder as _fr
 from . import metrics
 from . import relay as relay_mod
+from . import straggler as _sg
 from .controller import Controller, MessageTable, construct_response
 from .fusion import fuse_responses
 from .message import (Request, RequestType, Response, ResponseType,
@@ -227,7 +228,8 @@ class CoordinatorServer:
                  registration_timeout_s: float = 30.0,
                  fanout: int = 0,
                  on_rank_lost=None,
-                 tune_session=None):
+                 tune_session=None,
+                 on_rank_slow=None):
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.timeline = timeline
@@ -351,6 +353,18 @@ class CoordinatorServer:
         self.uplink_frames = 0
         self.bcast_ns = 0
         self.bcast_sends = 0
+        # --- live straggler observatory (common/straggler.py): fold
+        #     the CH/RQ arrival order — today's discard — into
+        #     per-rank lag EWMAs, adopt the MR/MA-carried worker phase
+        #     summaries so attribution keeps working during replay,
+        #     and refresh the hvd_straggler_score gauges on a small
+        #     loop.  None when disarmed: the frame dispatch hot path
+        #     then pays exactly one attribute check.  Constructed
+        #     BEFORE any serving thread starts (frames may dispatch
+        #     the moment the accept loop runs).
+        self._straggler = _sg.StragglerScorer(
+            size, on_slow=on_rank_slow) if _sg.ENABLED else None
+        self._straggler_thread = None
         self._mux = None
         if self._tree:
             # Selector/batched recv loop: ONE thread drains every root
@@ -413,6 +427,11 @@ class CoordinatorServer:
                 target=self._metrics_loop, name="hvd-coord-metrics",
                 daemon=True)
             self._metrics_thread.start()
+        if self._straggler is not None:
+            self._straggler_thread = threading.Thread(
+                target=self._straggler_loop,
+                name="hvd-coord-straggler", daemon=True)
+            self._straggler_thread.start()
 
     def _accept_loop(self):
         self._srv.settimeout(0.5)
@@ -1632,6 +1651,112 @@ class CoordinatorServer:
         merged["ranks"] = sorted(ranks)
         return merged
 
+    # ------------------------------------------------------------------
+    # live straggler observatory (common/straggler.py)
+    # ------------------------------------------------------------------
+    _STRAGGLER_REFRESH_S = 0.5
+
+    def _straggler_loop(self):
+        """Fold the MR/MA-carried per-rank phase summaries into the
+        scorer and refresh scores/flags.  Runs at a fixed small
+        cadence — the work is O(world) dict math, and the refresh must
+        keep going during steady-state replay, when no negotiation
+        arrival ever lands.  When the metrics-aggregation loop is NOT
+        armed, this loop issues the MQ polls itself (every other
+        tick): the observatory is self-sufficient, not parasitic on
+        HOROVOD_METRICS_AGG_SECONDS."""
+        sg = self._straggler
+        tick = 0
+        while not self._stop.wait(self._STRAGGLER_REFRESH_S):
+            tick += 1
+            if self._metrics_interval_s <= 0 and tick % 2 == 0:
+                self.request_metrics()
+            with self._lock:
+                # Snapshot dicts are replaced wholesale on update
+                # (never mutated in place), so holding references
+                # outside the lock is safe.
+                aggs = [a.get("snapshot") or {}
+                        for a in self._relay_metrics.values()]
+                snaps = list(self._rank_metrics.values())
+            per_rank = {}
+            for snap in aggs:        # relay aggregates first ...
+                per_rank.update(_sg.phases_from_snapshot(snap))
+            for snap in snaps:       # ... direct MR replies overlay
+                per_rank.update(_sg.phases_from_snapshot(snap))
+            if per_rank:
+                # hvdlint: hot-ok(cold loop thread; it exists only
+                # when the scorer does)
+                sg.note_worker_phases(per_rank)
+            sg.refresh()
+
+    def straggler_top(self):
+        """(rank, score) of the top rank currently FLAGGED slow —
+        i.e. past the threshold/hysteresis gate — or None (also None
+        when the observatory is disarmed).  The stall machinery
+        consumes a slow-vs-dead VERDICT here, not a raw score: a
+        sub-threshold residual EWMA must never steer an operator away
+        from the wedged-rank diagnosis.  Raw scores stay visible in
+        /status."""
+        sg = self._straggler
+        if sg is None:
+            return None
+        top = sg.top()
+        if top is None or top[0] not in sg.flagged():
+            return None
+        return top
+
+    def status(self) -> dict:
+        """The /status plane's cluster view (JSON-ready): per-rank
+        liveness + straggler state, negotiation counters, and queue
+        shape — the live "which rank is slow RIGHT NOW" answer next
+        to the post-hoc /metrics and /blackbox planes."""
+        now = time.monotonic()
+        with self._lock:
+            ranks = {}
+            for r in range(self.size):
+                if r in self._lost:
+                    st = "lost"
+                elif r in self._limbo:
+                    st = "limbo"
+                elif r in self._conns or r in self._rank_via:
+                    st = "alive"
+                    heard = self._last_heard.get(r)
+                    if self.liveness_interval_s > 0 and \
+                            heard is not None and \
+                            now - heard > self.liveness_timeout_s:
+                        # Connected but silent past the deadline: the
+                        # SIGSTOP/GIL-deadlock shape, pre-promotion.
+                        st = "wedged"
+                else:
+                    st = "unknown"
+                d = {"state": st}
+                heard = self._last_heard.get(r)
+                if heard is not None:
+                    d["last_heard_age_s"] = round(now - heard, 3)
+                rid = self._rank_via.get(r)
+                if rid is not None:
+                    d["via_relay"] = rid
+                ranks[str(r)] = d
+            out = {
+                "size": self.size,
+                "formed": self._formed,
+                "broken": self._broken,
+                "pending_tensors": len(self._table.entries),
+                "pending_barriers": len(self._barriers),
+                "negotiation": dict(self.stats),
+            }
+        sg = self._straggler
+        if sg is not None:
+            snap = sg.snapshot()
+            out["straggler"] = snap
+            for r_s, d in ranks.items():
+                score = snap["scores"].get(r_s)
+                if score is not None:
+                    d["score"] = score
+                    d["slow"] = int(r_s) in snap["flagged"]
+        out["ranks"] = ranks
+        return out
+
     def _on_rank_lost(self, rank: int, clean: bool,
                       reason: Optional[str] = None):
         """A rank departed mid-run.  In elastic mode, pending
@@ -1648,6 +1773,12 @@ class CoordinatorServer:
                 self._on_rank_lost_hook(rank, clean, reason)
             except Exception:
                 logger.warning("rank-lost hook failed", exc_info=True)
+        if self._straggler is not None:
+            # Same eviction contract as the metrics snapshot below: a
+            # lost rank's frozen lag/wait EWMAs (and slow flag) must
+            # stop contributing, or it could read as "top straggler"
+            # forever — the dead-as-slow misdiagnosis.
+            self._straggler.drop_rank(rank)
         with self._lock:
             # A departed rank must stop contributing to the merged
             # metrics view: its frozen last snapshot would otherwise be
@@ -1686,6 +1817,10 @@ class CoordinatorServer:
             self._barrier_members.clear()
             self._first_seen.clear()
             self._bit_only.clear()
+            if self._straggler is not None:
+                # Every in-flight negotiation just failed: its partial
+                # arrival sets are not lag samples.
+                self._straggler.reset_pending()
             msg = (f"rank {rank} left the job "
                    f"({'clean' if clean else reason or 'connection lost'}); "
                    "membership changed")
@@ -1828,6 +1963,12 @@ class CoordinatorServer:
         # Every per-tensor dict below is keyed by (process_set_id,
         # name): the same name may be live on two process sets at once
         # (reference analog: per-set controllers in process_set.h).
+        # Straggler attribution rides the arrival order this loop
+        # already observes (and used to discard): one timestamp per
+        # uplink frame is plenty — cross-rank order is what matters,
+        # intra-frame order is meaningless.
+        sg = self._straggler
+        sg_now = time.monotonic() if sg is not None else 0.0
         ready: List[Tuple[tuple, Optional[List[Request]], Optional[Response]]] = []
         for req, from_cache in items:
             name = req.tensor_name
@@ -1855,6 +1996,11 @@ class CoordinatorServer:
                     # construct_response records zeros for it.
                     for ckey, msgs in self._scan_complete():
                         self._bit_only[ckey] = False
+                        if sg is not None:
+                            # Join-forced completion: the arrival set
+                            # is missing the joined rank — not a fair
+                            # lag sample.  Drop, don't attribute.
+                            sg.note_abandon(ckey)
                         ready.append((ckey, msgs, None))
                 continue
             if req.request_type == RequestType.BARRIER:
@@ -1892,6 +2038,8 @@ class CoordinatorServer:
                 self._bit_only.setdefault(key, True)
             required = self._required_for(req) or self.size
             self._first_seen.setdefault(key, time.monotonic())
+            if sg is not None:
+                sg.note_arrival(key, rank, sg_now)
             complete = self._table.increment(
                 req, required,
                 joined_count=self._joined_count_for(req))
@@ -1900,6 +2048,8 @@ class CoordinatorServer:
             if complete:
                 msgs = self._table.pop(key)
                 self._first_seen.pop(key, None)
+                if sg is not None:
+                    sg.note_complete(key)
                 ready.append((key, msgs, None))
         if not ready:
             self._flush_evictions_locked()
@@ -2272,17 +2422,35 @@ class CoordinatorServer:
                 # events), not just which ranks are missing.
                 recent = _fr.recent_for_tensors([name]) \
                     if _fr.ENABLED else []
+                # Straggler attribution: "everyone blocked on rank 3"
+                # (the top straggler IS among the missing — slow, not
+                # dead; the pre-emptive-migration case) reads very
+                # differently from "no straggler signal" (suspect a
+                # wedged rank or the coordinator's own links).
+                top = self.straggler_top()
+                if top is not None and top[0] in missing:
+                    sg_note = (" Missing ranks appear blocked behind "
+                               "straggler rank %d (score %.1f): slow,"
+                               " not dead." % top)
+                elif top is not None:
+                    sg_note = (" Top straggler rank %d (score %.1f) "
+                               "is not among the missing ranks; "
+                               "suspect a wedged rank or link "
+                               "instead." % top)
+                else:
+                    sg_note = ""
                 logger.warning(
                     "STALL: tensor %s — ranks %s submitted, ranks %s "
                     "have not, for %.0fs. One or more ranks may be "
-                    "running a different graph or have hung.%s",
-                    name, submitted, missing, age,
+                    "running a different graph or have hung.%s%s",
+                    name, submitted, missing, age, sg_note,
                     (" Last recorder events: %s" % recent)
                     if recent else "")
                 if _fr.ENABLED:
                     _fr.record(_fr.STALL, rank=0, role="coord",
                                tensor=name, submitted=submitted,
-                               missing=missing, age_s=round(age, 3))
+                               missing=missing, age_s=round(age, 3),
+                               straggler=list(top) if top else None)
                 if 0 < self._stall_shutdown_s <= age:
                     logger.error(
                         "stalled tensor %s exceeded shutdown threshold "
@@ -2292,6 +2460,8 @@ class CoordinatorServer:
                         _fr.trigger_dump("stall_shutdown")
                     with self._lock:
                         msgs = self._table.pop(key)
+                        if self._straggler is not None:
+                            self._straggler.note_abandon(key)
                         # Barriers stall too (tracked outside the
                         # message table); fail the arrived ranks the
                         # same way.
@@ -2363,6 +2533,11 @@ class NetworkController(Controller):
         # True while an MR (metrics snapshot) reply thread is in
         # flight; written only by the recv thread.
         self._mr_sending = False
+        # Straggler-observatory phase collector (wired by the runtime;
+        # its EWMAs are folded into rank-labeled gauges right before
+        # each MR reply so the per-rank summaries ride the existing
+        # metrics frames).
+        self._phase_collector = None
         self._replay_observer = None
         # --- self-healing control plane (docs/failure_recovery.md) ---
         # _selfheal is THE hot-path gate: None when both liveness and
@@ -2480,6 +2655,12 @@ class NetworkController(Controller):
         event-driven instead of a poll."""
         self._on_receive = fn
 
+    def set_phase_collector(self, collector):
+        """Runtime hook (common/straggler.py): the per-runtime phase
+        collector whose EWMAs each MR reply publishes under this
+        rank's label."""
+        self._phase_collector = collector
+
     def set_replay_observer(self, observer):
         """Steady-state replay hook (common/replay.py): the recv thread
         reports response/eviction/param frames so the tracker can
@@ -2573,10 +2754,21 @@ class NetworkController(Controller):
                 "HOROVOD_COORD_FANOUT>0: the relay-tree control plane "
                 "requires the Python coordinator (relay frames).  "
                 "Unset one of the two.")
+        # The straggler observatory is Python-coordinator-only too:
+        # arrival attribution lives in the Python _process loop and
+        # the worker phase summaries ride MR frames the native server
+        # does not speak.  Same gating rule as the features above.
+        if strict_native and _sg.ENABLED:
+            raise RuntimeError(
+                "HOROVOD_TPU_NATIVE=1 is incompatible with "
+                "HOROVOD_STRAGGLER=1: the straggler observatory "
+                "requires the Python coordinator (CH/RQ arrival "
+                "attribution + MR phase frames).  Unset one of the "
+                "two.")
         if state.timeline is None and param_manager is None and \
                 tune_session is None and \
                 metrics_interval <= 0 and not _fp.ENABLED and \
-                not selfheal and not tree:
+                not selfheal and not tree and not _sg.ENABLED:
             try:
                 from ..native import NativeCoordinatorServer, available
                 if strict_native and not available():
@@ -2618,7 +2810,8 @@ class NetworkController(Controller):
             registration_timeout_s=state.knobs.registration_timeout_s,
             fanout=getattr(state.knobs, "coord_fanout", 0),
             on_rank_lost=self._make_rank_lost_publisher(state),
-            tune_session=tune_session)
+            tune_session=tune_session,
+            on_rank_slow=self._make_rank_slow_publisher())
 
     def _make_rank_lost_publisher(self, state):
         """Rank-0 hook: publish non-clean rank-lost promotions to the
@@ -2660,6 +2853,39 @@ class NetworkController(Controller):
             # client's full HTTP timeout.
             threading.Thread(target=publish, args=(rank, reason),
                              name="hvd-lost-publish", daemon=True
+                             ).start()
+
+        return hook
+
+    def _make_rank_slow_publisher(self):
+        """Rank-0 hook: publish straggler-threshold crossings to the
+        rendezvous KV under ``elastic/slow/<rank>`` — the consumable
+        signal for verdict-driven pre-emptive migration (ROADMAP item
+        5c; the slow-rank mirror of the ``elastic/lost-<rank>``
+        promotion notice).  Wired here; the elastic driver does not
+        act on it yet."""
+        client = self._rendezvous_client()
+        if client is None:
+            return None
+
+        def publish(rank, score, _client=client):
+            try:
+                _client.put("elastic", "slow-%d" % rank, json.dumps({
+                    "rank": rank,
+                    "score": round(score, 3),
+                    "wall": time.time(),
+                }).encode())
+            except OSError:
+                logger.warning("could not publish the slow-rank "
+                               "notice to the rendezvous KV",
+                               exc_info=True)
+
+        def hook(rank, score):
+            # Off the scorer's refresh loop: a slow/partitioned
+            # rendezvous must not stall score refreshes for the
+            # client's full HTTP timeout.
+            threading.Thread(target=publish, args=(rank, score),
+                             name="hvd-slow-publish", daemon=True
                              ).start()
 
         return hook
@@ -3366,6 +3592,14 @@ class NetworkController(Controller):
     def _send_metrics_snapshot(self):
         """MQ poll answer: ship this process's registry snapshot to
         the coordinator."""
+        if _sg.ENABLED and self._phase_collector is not None:
+            # Fold this rank's phase EWMAs into its rank-labeled
+            # gauges so THIS reply carries them: the per-rank
+            # summaries ride the existing MR frame (and survive relay
+            # MA pre-aggregation, because each rank only writes its
+            # own label) — zero new wire kinds, zero extra frames,
+            # and attribution keeps working during replay.
+            self._phase_collector.publish(self.rank)
         try:
             payload = json.dumps(metrics.snapshot()).encode()
         except (TypeError, ValueError):

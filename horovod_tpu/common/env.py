@@ -185,6 +185,38 @@ def start_timeout(default: float = None) -> float:
 HOROVOD_BLACKBOX = "HOROVOD_BLACKBOX"
 HOROVOD_BLACKBOX_DIR = "HOROVOD_BLACKBOX_DIR"
 HOROVOD_BLACKBOX_EVENTS = "HOROVOD_BLACKBOX_EVENTS"
+# Live straggler observatory (common/straggler.py): per-cycle
+# critical-path attribution on the coordinator (which rank's readiness
+# arrived last, folded into per-rank lag EWMAs), per-rank phase
+# summaries riding the MR metrics frames so attribution keeps working
+# during steady-state replay, hvd_straggler_score{rank} gauges, and
+# the /status plane + tools/hvdtop.py dashboard.  HOROVOD_STRAGGLER=1
+# arms it; disabled cost on the submit/recv hot paths is ONE attribute
+# check (the failpoints/flight-recorder precedent, pinned by
+# tests/test_straggler.py).
+HOROVOD_STRAGGLER = "HOROVOD_STRAGGLER"
+# A rank whose normalized lag score crosses this threshold is flagged
+# slow: one flight-recorder event + an elastic/slow/<rank> rendezvous
+# KV notice (the pre-emptive-migration hook, ROADMAP item 5c).
+HOROVOD_STRAGGLER_THRESHOLD = "HOROVOD_STRAGGLER_THRESHOLD"
+STRAGGLER_THRESHOLD_DEFAULT = 4.0
+# Noise floor (seconds): arrival-lag / peer-wait gaps below this never
+# score — a tight world full of microsecond jitter must read all-zero.
+HOROVOD_STRAGGLER_MIN_LAG = "HOROVOD_STRAGGLER_MIN_LAG"
+STRAGGLER_MIN_LAG_DEFAULT = 0.005
+
+
+def straggler_threshold() -> float:
+    """Score threshold for flagging a rank slow, parsed freshly (the
+    drills sweep it per phase)."""
+    return env_float(HOROVOD_STRAGGLER_THRESHOLD,
+                     STRAGGLER_THRESHOLD_DEFAULT)
+
+
+def straggler_min_lag() -> float:
+    """The attribution noise floor in seconds (see above)."""
+    return max(1e-4, env_float(HOROVOD_STRAGGLER_MIN_LAG,
+                               STRAGGLER_MIN_LAG_DEFAULT))
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 # Opt-in Prometheus-text /metrics endpoint: set to a port (0 = pick an
 # ephemeral one); unset = no endpoint.  Each rank binds

@@ -85,6 +85,12 @@ ACTIONS = ("delay", "drop", "error", "crash", "partition")
 # before anything else.  configure()/reset() are the only writers.
 ENABLED = False
 
+# Bumped on every configure()/reset(): consumers that derive cached
+# state from the rule set (steady-state replay's replay-safe-site
+# check) re-derive when it changes instead of taking the registry lock
+# on every hot-path evaluation.
+CONFIG_GEN = 0
+
 _TRIGGERS = metrics.counter(
     "hvd_failpoint_triggers_total",
     "Failpoint rules fired, by site and action")
@@ -287,10 +293,12 @@ def configure(spec: str, seed: Optional[int] = None) -> int:
         rule = _parse_rule(part, seed, count)
         rules.setdefault(rule.site, []).append(rule)
         count += 1
+    global CONFIG_GEN
     with _lock:
         _seed = seed
         _rules = rules
         ENABLED = bool(rules)
+        CONFIG_GEN += 1
     if rules:
         logger.info("failpoints enabled (seed=%d): %s", seed,
                     "; ".join("%s=%s" % (r.site, r.action)
@@ -300,10 +308,11 @@ def configure(spec: str, seed: Optional[int] = None) -> int:
 
 def reset():
     """Disable the subsystem and drop all rules/counters."""
-    global ENABLED, _rules
+    global ENABLED, _rules, CONFIG_GEN
     with _lock:
         _rules = {}
         ENABLED = False
+        CONFIG_GEN += 1
 
 
 def maybe_fail(site: str, rank: Optional[int] = None,

@@ -105,6 +105,8 @@ ELASTIC = "elastic"          # event, epoch?, rank?
 FAILPOINT = "failpoint"      # site, action
 FATAL = "fatal"              # error — this rank's world broke
 STALL = "stall"              # tensor, missing — stall machinery fired
+STRAGGLER = "straggler"      # peer, score — rank crossed the slow
+                             # threshold (common/straggler.py)
 SUBMIT = "submit"            # name, type — one eager collective
 NOTE = "note"                # harness / drill markers (drill.fault ...)
 

@@ -448,7 +448,8 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, registry: Optional[MetricsRegistry] = None,
                  cluster_provider: Optional[Callable[[], Optional[dict]]] = None,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 status_provider: Optional[Callable[[], Optional[dict]]] = None):
         from http.server import ThreadingHTTPServer
 
         from ..runner import job_secret
@@ -457,6 +458,7 @@ class MetricsServer:
 
         self._registry = registry if registry is not None else REGISTRY
         self._cluster_provider = cluster_provider
+        self._status_provider = status_provider
         server_self = self
 
         class _MetricsHandler(KVStoreHandler):
@@ -472,6 +474,37 @@ class MetricsServer:
                     from . import flight_recorder
                     body = json.dumps(flight_recorder.dump_dict(
                         reason="http")).encode()
+                    self.send_response(OK)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/status":
+                    # Live cluster/status view (common/straggler.py +
+                    # hvd.status()): per-rank alive/limbo/wedged/slow,
+                    # replay + tune phase, queue depth, straggler
+                    # scores — behind the SAME job-secret HMAC as
+                    # /metrics (a liveness map is a topology map,
+                    # never an unauthenticated sidechannel).  404
+                    # when no provider is wired (bare registry
+                    # servers).
+                    provider = server_self._status_provider
+                    if provider is None:
+                        self.send_response(NOT_FOUND)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    try:
+                        payload = provider()
+                    except Exception:
+                        logger.debug("status provider failed",
+                                     exc_info=True)
+                        payload = None
+                    body = json.dumps(
+                        payload if payload is not None else {}
+                    ).encode()
                     self.send_response(OK)
                     self.send_header("Content-Type",
                                      "application/json")
@@ -537,7 +570,8 @@ class MetricsServer:
 
 
 def serve(port: int = 0, registry: Optional[MetricsRegistry] = None,
-          cluster_provider=None, secret: Optional[str] = None
-          ) -> MetricsServer:
+          cluster_provider=None, secret: Optional[str] = None,
+          status_provider=None) -> MetricsServer:
     return MetricsServer(port=port, registry=registry,
-                         cluster_provider=cluster_provider, secret=secret)
+                         cluster_provider=cluster_provider, secret=secret,
+                         status_provider=status_provider)

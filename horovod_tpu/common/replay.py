@@ -122,6 +122,18 @@ REPLAYABLE = {RequestType.ALLREDUCE, RequestType.ADASUM,
 _TRACKED_RESPONSES = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
                       ResponseType.BROADCAST}
 
+# Failpoint sites whose effect is NOT bypassed by replay: they fire
+# on the submitting thread BEFORE replay handling (runtime.submit is
+# evaluated at the top of BackgroundRuntime.submit), so a schedule
+# armed ONLY at these sites keeps its full effect under a frozen
+# schedule and must not pin the negotiated path.  The straggler drills
+# depend on this: a failpoint-delayed rank stays slow while replay
+# stays engaged (docs/steady_state_replay.md).  Any other armed site
+# still pins negotiation — fault schedules normally target the wire
+# sites replay bypasses, and silently skipping them would report a
+# vacuous pass.
+REPLAY_SAFE_SITES = frozenset({"runtime.submit"})
+
 # A cycle that never closes (auto-named tensors — every unnamed eager
 # op gets a fresh "<op>.noname.<n>" key, so no leading key ever
 # repeats) would otherwise accumulate tracking state forever.  Past
@@ -197,6 +209,12 @@ class SteadyStateReplay:
         # replay then engages cleanly on the tuned schedule.  This
         # replaces the old blanket autotune-disables-replay exclusion.
         self._tuning = False
+        # Cached replay-safe verdict for the current failpoint rule
+        # set (see REPLAY_SAFE_SITES): re-derived only when the
+        # failpoint config generation changes, so the hot path never
+        # takes the failpoint registry lock.
+        self._fp_gen = -1
+        self._fp_pins = True
 
     # ------------------------------------------------------------------
     # submission-side hooks (called from BackgroundRuntime.submit)
@@ -251,9 +269,11 @@ class SteadyStateReplay:
         with self._lock:
             if not self.active:
                 return False
-            if _fp.ENABLED:
+            if _fp.ENABLED and self._failpoints_pin_locked():
                 # Armed failpoints pin the negotiated path: fault
                 # schedules target the wire sites replay bypasses.
+                # Replay-safe schedules (REPLAY_SAFE_SITES only) keep
+                # their effect under replay and don't exit.
                 self._exit_locked("failpoint")
                 return False
             key, sig = self._key(req), request_signature(req)
@@ -297,6 +317,19 @@ class SteadyStateReplay:
             finally:
                 self._exec_lock.release()
         return True
+
+    def _failpoints_pin_locked(self) -> bool:
+        """True when the armed failpoint schedule targets any site
+        replay would bypass (caller holds self._lock and has already
+        seen _fp.ENABLED).  The verdict is cached per failpoint config
+        generation — re-derived on configure()/reset(), never on the
+        per-op path."""
+        gen = _fp.CONFIG_GEN
+        if gen != self._fp_gen:
+            self._fp_gen = gen
+            self._fp_pins = any(site not in REPLAY_SAFE_SITES
+                                for site in _fp.sites())
+        return self._fp_pins
 
     def note_disruption(self, reason: str):
         """A non-replayable event in the submission stream (group,
@@ -544,13 +577,15 @@ class SteadyStateReplay:
                                rank=self.runtime.state.rank_info.rank,
                                phase="held", reason="tuning")
             return False
-        if _fp.ENABLED:
+        if _fp.ENABLED and self._failpoints_pin_locked():
             # Armed failpoints pin the negotiated path (fault
-            # schedules target the wire sites replay bypasses).
-            # Checked at ENTRY, not only in replay_submit: otherwise
-            # a chaos run would enter and immediately exit every
-            # warmup-K cycles, inflating the entry/exit counters and
-            # spamming REPLAY_ENTER/EXIT timeline instants forever.
+            # schedules target the wire sites replay bypasses;
+            # replay-safe schedules — REPLAY_SAFE_SITES only — keep
+            # their effect under replay and don't pin).  Checked at
+            # ENTRY, not only in replay_submit: otherwise a chaos run
+            # would enter and immediately exit every warmup-K cycles,
+            # inflating the entry/exit counters and spamming
+            # REPLAY_ENTER/EXIT timeline instants forever.
             return False
         delivered = getattr(self, "_last_delivered", None)
         if not delivered:

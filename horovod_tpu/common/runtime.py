@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 from . import failpoints as _fp
 from . import flight_recorder as _fr
 from . import metrics
+from . import straggler as _sg
 from . import timeline as tl
 from .controller import LoopbackController
 from .message import (Request, RequestType, Response, ResponseType)
@@ -54,14 +55,20 @@ _JOIN_ZEROS = metrics.counter(
     "did not submit")
 
 
-def _latency_wrapped(cb):
+def _latency_wrapped(cb, collector=None):
     """Stamp submit time into the completion callback so the
     submit-to-callback latency histogram sees every path (negotiated,
     inline cache hit, error flush)."""
     t0 = time.perf_counter()
 
     def wrapped(ok, result):
-        _SUBMIT_LATENCY.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _SUBMIT_LATENCY.observe(dt)
+        if _sg.ENABLED and collector is not None:
+            # Straggler observatory: the submit→executed e2e phase
+            # EWMA (published into MR frames by the controller).
+            # Disabled cost: this one attribute check.
+            collector.note_latency(dt)
         return cb(ok, result)
     return wrapped
 
@@ -81,7 +88,25 @@ class BackgroundRuntime:
             world_size=state.rank_info.size,
         ) if not state.knobs.stall_check_disable else None
         self.timeline = None
+        # Per-runtime phase-time EWMAs for the straggler observatory
+        # (common/straggler.py): fed from the hot paths behind the
+        # ENABLED gate, published into MR metrics frames by the
+        # controller (rank-labeled, so relay pre-aggregation carries
+        # every rank's summary through intact).
+        self.phase_collector = _sg.PhaseCollector()
         self.controller = self._make_controller()
+        if hasattr(self.controller, "set_phase_collector"):
+            self.controller.set_phase_collector(self.phase_collector)
+        if self.stall_inspector is not None:
+            # On the rank hosting the Python coordinator, local stall
+            # warnings also name the current top straggler — "everyone
+            # blocked on rank 3" reads differently from "coordinator
+            # wedged" (common/straggler.py).  getattr chains resolve
+            # to None everywhere else (loopback, workers, native).
+            top = getattr(getattr(self.controller, "server", None),
+                          "straggler_top", None)
+            if top is not None:
+                self.stall_inspector.set_straggler_provider(top)
         self._shutdown = threading.Event()
         self._wake = threading.Event()
         # Direct dispatch: the controller's recv thread EXECUTES each
@@ -217,7 +242,8 @@ class BackgroundRuntime:
             _fr.record(_fr.SUBMIT, rank=self.state.rank_info.rank,
                        name=request.tensor_name,
                        type=request.request_type.name)
-        entry.callback = _latency_wrapped(entry.callback)
+        entry.callback = _latency_wrapped(entry.callback,
+                                          self.phase_collector)
         nelem = 1
         for d in request.tensor_shape:
             nelem *= d
@@ -300,7 +326,8 @@ class BackgroundRuntime:
             self.replay.note_disruption("group")
         group_id = next(self._group_counter)
         for entry in entries:
-            entry.callback = _latency_wrapped(entry.callback)
+            entry.callback = _latency_wrapped(entry.callback,
+                                              self.phase_collector)
         for request in requests:
             request.group_id = group_id
             nelem = 1
@@ -542,6 +569,7 @@ class BackgroundRuntime:
         names = [e.tensor_name for e in entries]
         tl_name = names[0]
         ps_ranks = tuple(resp.process_set_ranks)
+        sg_t0 = time.perf_counter() if _sg.ENABLED else 0.0
         if self.timeline:
             self.timeline.counter("fused_bytes", {"bytes": int(sum(
                 getattr(e.tensor, "nbytes", 0) for e in entries))})
@@ -595,5 +623,11 @@ class BackgroundRuntime:
                 e.callback(False, err)
             return
 
+        if _sg.ENABLED:
+            # The fused→executed phase slice (the e2e EWMA comes from
+            # the latency wrapper above); per-rank publication happens
+            # on the cold MR-reply path, never here.
+            self.phase_collector.note_exec(
+                time.perf_counter() - sg_t0)
         for e, result in zip(entries, results):
             e.callback(True, result)

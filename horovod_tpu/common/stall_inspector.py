@@ -30,6 +30,30 @@ class StallInspector:
         # tensor name -> (first seen ts, set of ranks that reported)
         self._uncompleted: Dict[str, Tuple[float, Set[int]]] = {}
         self._warned: Set[str] = set()
+        # Optional live-straggler hook (common/straggler.py): when the
+        # coordinator's scorer is armed on this rank, warnings name
+        # the current top straggler so "everyone blocked on a slow
+        # rank" is distinguishable from "a rank died / coordinator
+        # wedged" without a postmortem.
+        self._straggler_provider = None
+
+    def set_straggler_provider(self, fn):
+        """``fn() -> Optional[(rank, score)]`` — wired by the runtime
+        on the rank hosting the Python coordinator."""
+        self._straggler_provider = fn
+
+    def _straggler_note(self) -> str:
+        if self._straggler_provider is None:
+            return ""
+        try:
+            top = self._straggler_provider()
+        except Exception:
+            return ""
+        if top is None:
+            return ""
+        return (". Current top straggler: rank %d (score %.1f) — if "
+                "it is among the waiting ranks, they are slow, not "
+                "dead" % top)
 
     def record_uncached_tensor(self, name: str, rank: int):
         now = time.monotonic()
@@ -76,8 +100,9 @@ class StallInspector:
                 if _fr.ENABLED and invalidate else []
             logger.warning(
                 "One or more tensors were submitted to be reduced/gathered "
-                "but some ranks have not yet submitted them. Stalled ops: %s%s",
+                "but some ranks have not yet submitted them. Stalled ops: %s%s%s",
                 "; ".join(stalled_msgs),
+                self._straggler_note(),
                 (". Last recorder events: %s" % recent) if recent
                 else "")
         return invalidate

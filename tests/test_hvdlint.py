@@ -251,6 +251,35 @@ def test_hot_path_gate_else_branch_is_not_guarded():
     assert [v.line for v in violations] == [9, 10]
 
 
+def test_hot_path_gate_polices_straggler_note_sites():
+    """Observability note_* feeders (the straggler collector/scorer)
+    must sit behind an ENABLED check of the straggler module or an
+    `is not None` guard on the object; `self.`-internal dispatch is
+    out of scope."""
+    src = _HOT_HEADER + (
+        "from . import straggler as _sg\n"
+        "def handle(col, sg, dt):\n"
+        "    col.note_latency(dt)\n"                  # unguarded
+        "    if _sg.ENABLED:\n"
+        "        col.note_exec(dt)\n"                 # ENABLED guard
+        "    if sg is not None:\n"
+        "        sg.note_arrival('k', 1, dt)\n"       # None guard
+        "    self_like = sg\n"
+        "    if sg is not None and dt > 0:\n"
+        "        self_like.note_complete('k')\n"      # BoolOp guard
+        "    # hvdlint: hot-ok(cold path, loop exists iff scorer does)\n"
+        "    sg.note_worker_phases({})\n"             # annotated
+        "class R:\n"
+        "    def on_broken(self):\n"
+        "        self.note_disruption('broken')\n"    # self-dispatch
+    )
+    project = Project.from_strings(
+        {"horovod_tpu/common/runtime.py": src})
+    violations = run_checks(project, ["hot-path-gate"])
+    assert _idents(violations) == {"unguarded-note"}
+    assert [v.line for v in violations] == [7]
+
+
 def test_hot_path_gate_guarded_and_unmarked_clean():
     guarded = _HOT_HEADER + (
         "_C = metrics.counter('hvd_ok_total', 'module scope')\n"

@@ -303,6 +303,28 @@ def test_tree_metrics_aggregation_covers_all_ranks():
         world.close()
 
 
+@pytest.mark.chaos
+def test_straggler_attribution_rides_ma_aggregation():
+    """Relay-tree straggler satellite: with replay engaged at
+    fanout=2 the coordinator's negotiation view is dark AND most
+    ranks' MR replies are consumed by their relays — the scorer must
+    still name the failpoint-delayed rank from the per-rank phase
+    summaries carried through MR→MA pre-aggregation (per-rank labels
+    survive the snapshot merge; the root never sees one blended
+    number per subtree)."""
+    from chaos_soak import run_straggler_drill
+
+    agg = hm.REGISTRY.counter("hvd_relay_agg_metrics_total")
+    agg0 = agg.value()
+    rec = run_straggler_drill(mode="replay", ranks=8, victim=5,
+                              delay_ms=25.0, seed=2, fanout=2)
+    assert rec["ok"], {k: rec.get(k) for k in
+                       ("named", "tta_s", "victim_score", "replay",
+                        "scores", "hangs", "errors")}
+    # The per-rank data really rode MA frames (relays pre-aggregated).
+    assert agg.value() > agg0
+
+
 def test_flat_star_still_selectable(monkeypatch):
     """HOROVOD_COORD_FANOUT=0 (the default) keeps the flat star: no
     plan, no relays, no mux — the pre-tree thread-per-link paths."""

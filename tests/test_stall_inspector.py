@@ -88,3 +88,38 @@ def test_remove_unknown_tensor_is_noop():
     si = StallInspector(warning_time_s=1.0, world_size=2)
     si.remove("never-seen")
     assert si.check() == []
+
+
+def test_stall_warning_names_the_top_straggler(caplog):
+    """Straggler satellite: with a provider wired (the rank hosting
+    the coordinator's scorer), a stall warning names the current top
+    straggler so "everyone blocked on a slow rank" is distinguishable
+    from "a rank died"."""
+    si = StallInspector(warning_time_s=1.0, world_size=4)
+    si.set_straggler_provider(lambda: (3, 6.2))
+    si.record_uncached_tensor("grad/w", 0)
+    _age(si, 2.0)
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        assert si.check() == ["grad/w"]
+    msg = caplog.records[0].getMessage()
+    assert "top straggler: rank 3 (score 6.2)" in msg
+    assert "slow, not dead" in msg
+
+
+def test_stall_warning_quiet_without_straggler_signal(caplog):
+    si = StallInspector(warning_time_s=1.0, world_size=4)
+    si.set_straggler_provider(lambda: None)     # armed, no signal
+    si.record_uncached_tensor("grad/w", 0)
+    _age(si, 2.0)
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        si.check()
+    assert "straggler" not in caplog.records[0].getMessage()
+
+
+def test_stall_warning_survives_a_broken_provider(caplog):
+    si = StallInspector(warning_time_s=1.0, world_size=4)
+    si.set_straggler_provider(lambda: 1 / 0)    # must never raise out
+    si.record_uncached_tensor("grad/w", 0)
+    _age(si, 2.0)
+    with caplog.at_level(logging.WARNING, logger=STALL_LOGGER):
+        assert si.check() == ["grad/w"]

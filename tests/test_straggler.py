@@ -1,0 +1,418 @@
+"""Live straggler observatory (common/straggler.py): scorer
+semantics for both attribution sources, the per-rank-label MR/MA
+survival contract, the one-attribute-check disabled cost (booby-trap
++ timeit, the failpoints/flight-recorder precedent), the /status
+plane + hvdtop, and the 8-rank e2e drills in negotiation mode and
+with steady-state replay engaged (docs/observability.md)."""
+
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+from chaos_soak import run_straggler_drill  # noqa: E402
+
+from horovod_tpu.common import failpoints as fp  # noqa: E402
+from horovod_tpu.common import metrics  # noqa: E402
+from horovod_tpu.common import straggler as sg  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    sg.reset()
+    fp.reset()
+    yield
+    sg.reset()
+    fp.reset()
+
+
+# ---------------------------------------------------------------------------
+# scorer: negotiation (arrival-lag) source
+# ---------------------------------------------------------------------------
+
+def _feed_arrivals(scorer, rounds=10, size=8, slow_rank=3,
+                   slow_lag=0.03, jitter=0.0005):
+    t = time.monotonic()
+    for i in range(rounds):
+        key = (0, "t%d" % i)
+        for r in range(size):
+            lag = slow_lag if r == slow_rank else jitter * r
+            scorer.note_arrival(key, r, t + lag)
+        scorer.note_complete(key)
+
+
+def test_lag_source_names_the_slow_rank_and_flags_once():
+    fired = []
+    scorer = sg.StragglerScorer(8, threshold=4.0, min_lag_s=0.004,
+                                on_slow=lambda r, s: fired.append(
+                                    (r, round(s, 2))))
+    _feed_arrivals(scorer, slow_rank=3)
+    scores = scorer.refresh()
+    assert scorer.top()[0] == 3
+    assert scores[3] >= 4.0
+    assert all(s < 4.0 for r, s in scores.items() if r != 3)
+    assert scorer.flagged() == [3]
+    assert len(fired) == 1 and fired[0][0] == 3
+    # The hvd_straggler_score gauge covers EVERY rank (zeros included).
+    g = metrics.REGISTRY.gauge("hvd_straggler_score")
+    assert g.value(rank=3) >= 4.0
+    assert g.value(rank=0) == 0.0
+    # Hysteresis: still over threshold -> no second firing.
+    _feed_arrivals(scorer, slow_rank=3)
+    scorer.refresh()
+    assert len(fired) == 1
+    # Critical-path attribution counted the slow rank as last-arriver.
+    crit = metrics.REGISTRY.counter("hvd_critical_path_total")
+    assert crit.value(rank=3) >= 10
+    assert scorer.snapshot()["negotiation_samples"] >= 10
+
+
+def test_tight_world_scores_zero_under_the_noise_floor():
+    scorer = sg.StragglerScorer(8, threshold=4.0, min_lag_s=0.005)
+    # Everyone within 200 us of each other: all below min_lag.
+    _feed_arrivals(scorer, slow_rank=3, slow_lag=0.0002,
+                   jitter=0.000025)
+    scores = scorer.refresh()
+    assert all(s == 0.0 for s in scores.values())
+    assert scorer.top() is None
+    assert scorer.flagged() == []
+
+
+def test_lost_rank_is_dropped_from_scores_and_flags():
+    """A rank promoted to lost must stop reading as the top straggler
+    (dead-as-slow is the misdiagnosis the scorer exists to prevent);
+    the coordinator's _on_rank_lost calls drop_rank."""
+    scorer = sg.StragglerScorer(8, threshold=4.0, min_lag_s=0.004)
+    _feed_arrivals(scorer, slow_rank=3)
+    scorer.note_worker_phases(
+        {r: {"e2e": 0.0004 if r == 3 else 0.030} for r in range(8)})
+    scorer.refresh()
+    assert scorer.top()[0] == 3 and scorer.flagged() == [3]
+    scorer.drop_rank(3)
+    scores = scorer.refresh()
+    assert scorer.flagged() == []
+    assert 3 not in scores
+    assert metrics.REGISTRY.gauge(
+        "hvd_straggler_score").value(rank=3) == 0.0
+    top = scorer.top()
+    assert top is None or top[0] != 3
+
+
+def test_abandon_and_reset_drop_unfair_samples():
+    scorer = sg.StragglerScorer(4, threshold=4.0, min_lag_s=0.004)
+    t = time.monotonic()
+    scorer.note_arrival((0, "a"), 0, t)
+    scorer.note_arrival((0, "a"), 1, t + 0.5)
+    scorer.note_abandon((0, "a"))      # join-forced / stall shutdown
+    scorer.note_arrival((0, "b"), 0, t)
+    scorer.reset_pending()             # elastic break
+    assert scorer.refresh() == {}
+    assert scorer.snapshot()["negotiation_samples"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scorer: replay (wait-inversion) source + per-rank label survival
+# ---------------------------------------------------------------------------
+
+def test_wait_inversion_names_the_rank_peers_wait_on():
+    scorer = sg.StragglerScorer(8, threshold=4.0, min_lag_s=0.004)
+    # The classic signature: the slow rank waits ~0 inside collectives
+    # while every peer's e2e carries the delay it injected.
+    scorer.note_worker_phases(
+        {r: {"e2e": 0.0004 if r == 3 else 0.030} for r in range(8)})
+    scores = scorer.refresh()
+    assert scorer.top()[0] == 3
+    assert scores[3] >= 4.0
+    assert all(s < 4.0 for r, s in scores.items() if r != 3)
+
+
+def test_wait_inversion_ignores_mild_relative_variation():
+    scorer = sg.StragglerScorer(8, threshold=4.0, min_lag_s=0.005)
+    # Big absolute latencies, one rank slightly faster: gap/own-e2e is
+    # small, so nobody should be flagged.
+    scorer.note_worker_phases(
+        {r: {"e2e": 0.45 if r == 3 else 0.50} for r in range(8)})
+    scores = scorer.refresh()
+    assert all(s < 4.0 for s in scores.values())
+
+
+def test_phase_collector_publish_roundtrip_and_label_parse():
+    col = sg.PhaseCollector()
+    for _ in range(5):
+        col.note_latency(0.020)
+        col.note_exec(0.015)
+    col.publish(rank=5)
+    snap = metrics.snapshot()
+    per_rank = sg.phases_from_snapshot(snap)
+    assert 5 in per_rank
+    assert per_rank[5]["e2e"] == pytest.approx(0.020, rel=0.01)
+    assert per_rank[5]["execute"] == pytest.approx(0.015, rel=0.01)
+    assert per_rank[5]["negotiate"] == pytest.approx(0.005, rel=0.1)
+    assert col.local_phases()["e2e"] == pytest.approx(0.020, rel=0.01)
+
+
+def test_per_rank_labels_survive_subtree_merges():
+    """The MR→MA contract: each real process publishes ONLY its own
+    rank label, so relay pre-aggregation (a snapshot sum) and the
+    root's merge preserve every rank's value intact — never one
+    blended number per subtree."""
+    def rank_snap(rank, e2e):
+        reg = metrics.MetricsRegistry()
+        reg.gauge("hvd_worker_phase_seconds").set(
+            e2e, rank=rank, phase="e2e")
+        return reg.snapshot()
+
+    # fanout=2 shape: two relays each pre-merge a 4-rank subtree.
+    values = {r: 0.010 * (r + 1) for r in range(8)}
+    left = metrics.merge_snapshots(
+        [rank_snap(r, values[r]) for r in range(4)])
+    right = metrics.merge_snapshots(
+        [rank_snap(r, values[r]) for r in range(4, 8)])
+    root = metrics.merge_snapshots([left, right])
+    per_rank = sg.phases_from_snapshot(root)
+    assert sorted(per_rank) == list(range(8))
+    for r, v in values.items():
+        assert per_rank[r]["e2e"] == pytest.approx(v)
+
+
+# ---------------------------------------------------------------------------
+# replay interaction: replay-safe failpoint sites
+# ---------------------------------------------------------------------------
+
+def test_replay_safe_failpoint_sites_do_not_pin_negotiation():
+    from horovod_tpu.common.replay import (REPLAY_SAFE_SITES,
+                                           SteadyStateReplay)
+
+    assert "runtime.submit" in REPLAY_SAFE_SITES
+    rp = SteadyStateReplay(runtime=None, warmup_cycles=1)
+    fp.configure("runtime.submit=delay(0s,times=0)")
+    assert fp.ENABLED
+    assert not rp._failpoints_pin_locked()
+    # Any wire-site rule still pins (the chaos-schedule contract).
+    fp.configure("runtime.submit=delay(0s,times=0);"
+                 "coord.broadcast=drop(0)")
+    assert rp._failpoints_pin_locked()
+    # The verdict tracks the config generation both ways.
+    fp.configure("runtime.submit=delay(0s,times=0)")
+    assert not rp._failpoints_pin_locked()
+
+
+def test_strict_native_rejects_straggler(monkeypatch):
+    """HOROVOD_TPU_NATIVE=1 + HOROVOD_STRAGGLER=1 is a config error,
+    not a silent demotion (the native coordinator has no arrival
+    attribution and speaks no MR phase frames)."""
+    from chaos_soak import _StateStub, _free_port, soak_knobs
+    from horovod_tpu.common.controller_net import NetworkController
+
+    sg.configure(enabled=True)
+    monkeypatch.setenv("HOROVOD_TPU_NATIVE", "1")
+    monkeypatch.setenv("HOROVOD_CONTROLLER_ADDR",
+                       "127.0.0.1:%d" % _free_port())
+    monkeypatch.delenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", raising=False)
+    st = _StateStub(0, 4, soak_knobs(0.0))
+    with pytest.raises(RuntimeError, match="HOROVOD_STRAGGLER"):
+        NetworkController(st)
+
+
+# ---------------------------------------------------------------------------
+# the one-attribute-check perf pins (failpoints/flight-recorder precedent)
+# ---------------------------------------------------------------------------
+
+def test_disabled_sites_never_touch_the_collector(monkeypatch,
+                                                  hvd_single):
+    """Booby-trap: with the observatory disarmed, a real collective
+    through the runtime must never get past the ENABLED guard."""
+    assert not sg.ENABLED
+
+    def boom(*a, **k):
+        raise AssertionError("straggler collector touched while "
+                             "disabled")
+
+    monkeypatch.setattr(sg.PhaseCollector, "note_latency", boom)
+    monkeypatch.setattr(sg.PhaseCollector, "note_exec", boom)
+    out = np.asarray(hvd_single.allreduce(
+        np.ones(8, np.float32), op=hvd_single.Sum, name="sg.disabled"))
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_enabled_sites_feed_the_collector(hvd_single):
+    sg.configure(enabled=True)
+    hvd_single.allreduce(np.ones(4, np.float32), op=hvd_single.Sum,
+                         name="sg.enabled")
+    from horovod_tpu.common.basics import _state
+    phases = _state().runtime.phase_collector.local_phases()
+    assert phases.get("e2e", 0.0) > 0.0
+    assert "execute" in phases
+    status = hvd_single.status()
+    assert status["straggler_armed"]
+    assert status["phases"]["e2e"] > 0.0
+
+
+def test_disabled_path_overhead_stays_one_attribute_check():
+    import timeit
+
+    assert not sg.ENABLED
+    col = sg.PhaseCollector()
+    n = 200_000
+    per_call = timeit.timeit(
+        "sg.ENABLED and col.note_latency(0.0)",
+        globals={"sg": sg, "col": col}, number=n) / n
+    assert per_call < 1e-6, \
+        "disabled straggler guard costs %.0f ns/op (>1 us): no " \
+        "longer a bare attribute check" % (per_call * 1e9)
+
+
+# ---------------------------------------------------------------------------
+# /status plane + hvdtop
+# ---------------------------------------------------------------------------
+
+def test_status_endpoint_guarded_and_404_without_provider():
+    from horovod_tpu.runner import job_secret
+
+    secret = job_secret.make_secret_key()
+    srv = metrics.serve(port=0, registry=metrics.MetricsRegistry(),
+                        secret=secret,
+                        status_provider=lambda: {"rank": 0, "size": 1})
+    try:
+        url = "http://127.0.0.1:%d/status" % srv.port
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 403
+        ts = repr(time.time())
+        good = urllib.request.Request(url, headers={
+            job_secret.TS_HEADER: ts,
+            job_secret.HEADER: job_secret.sign(secret, "GET",
+                                               "/status", b"", ts)})
+        with urllib.request.urlopen(good, timeout=10) as r:
+            assert json.loads(r.read().decode())["size"] == 1
+    finally:
+        srv.stop()
+    bare = metrics.serve(port=0, registry=metrics.MetricsRegistry(),
+                         secret="")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/status" % bare.port, timeout=10)
+        assert exc.value.code == 404
+    finally:
+        bare.stop()
+
+
+def _canned_status():
+    return {
+        "rank": 0, "size": 4, "replay": {"enabled": True,
+                                         "active": True,
+                                         "cycles_replayed": 42},
+        "queue_depth": 0, "ops_dispatched": 10,
+        "cluster": {
+            "size": 4, "formed": True, "broken": False,
+            "pending_tensors": 0, "pending_barriers": 0,
+            "negotiation": {},
+            "straggler": {"threshold": 4.0, "scores": {"2": 5.5},
+                          "flagged": [2]},
+            "ranks": {
+                "0": {"state": "alive", "score": 0.1},
+                "1": {"state": "limbo"},
+                "2": {"state": "alive", "score": 5.5, "slow": True},
+                "3": {"state": "wedged", "last_heard_age_s": 3.2},
+            }}}
+
+
+def test_hvdtop_once_renders_and_exits_zero():
+    from tools import hvdtop
+
+    srv = metrics.serve(port=0, registry=metrics.MetricsRegistry(),
+                        secret="", status_provider=_canned_status)
+    try:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = hvdtop.main(["--once", "--url",
+                              "http://127.0.0.1:%d" % srv.port])
+        out = buf.getvalue()
+    finally:
+        srv.stop()
+    assert rc == 0
+    assert "SLOW" in out and "wedged" in out and "limbo" in out
+    assert "replay: active (42 cycles replayed)" in out
+
+
+def test_hvdtop_fetch_failure_exits_nonzero():
+    from tools import hvdtop
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = hvdtop.main(["--once", "--url",
+                          "http://127.0.0.1:1/status",
+                          "--timeout", "0.5"])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# e2e drills: 8 ranks over the real control plane (tier-1 smokes; the
+# heavier sweep rides the slow marker)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_negotiation_mode_names_the_failpoint_delayed_rank():
+    """Acceptance: a runtime.submit-delayed rank at 8 ranks is named
+    by hvd_straggler_score and /status within a bounded
+    time-to-attribution, and hvdtop --once renders the live world."""
+    rec = run_straggler_drill(mode="negotiation", ranks=8, victim=3,
+                              delay_ms=25.0, seed=0,
+                              serve_status=True)
+    assert rec["ok"], {k: rec.get(k) for k in
+                       ("named", "named_by_lag_source", "tta_s",
+                        "victim_score", "scores",
+                        "hangs", "errors", "hvdtop_rc")}
+    # Named by the arrival-lag source itself, not masked by the
+    # always-live wait-inversion source.
+    assert rec["named_by_lag_source"]
+    assert rec["tta_s"] < 10.0
+    assert rec["hvdtop_rc"] == 0
+    ranks = rec["status"]["cluster"]["ranks"]
+    assert ranks["3"]["slow"] and ranks["3"]["state"] == "alive"
+    assert any("SLOW" in line for line in rec["hvdtop_lines"])
+
+
+@pytest.mark.chaos
+def test_replay_mode_keeps_attribution_current():
+    """Acceptance: with replay engaged on every rank (negotiation-era
+    scorer state wiped), the MR-carried phase summaries re-name the
+    slow rank while hvd_steady_state_cycles_replayed keeps growing
+    and the slow rank never forces a replay exit."""
+    rec = run_straggler_drill(mode="replay", ranks=8, victim=3,
+                              delay_ms=25.0, seed=1)
+    assert rec["ok"], {k: rec.get(k) for k in
+                       ("named", "tta_s", "victim_score", "replay",
+                        "hangs", "errors")}
+    rp = rec["replay"]
+    assert rp["engaged"]
+    assert rp["cycles_replayed_at_named"] > 0
+    assert rp["cycles_replayed_after"] > rp["cycles_replayed_at_named"]
+    assert all(rp["active_at_end"])
+    assert rec["tta_s"] < 10.0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_straggler_matrix_slow():
+    """The heavier sweep: both modes x {flat, fanout-2 tree} x two
+    victims — kept off tier-1 (wall budget is near the cap)."""
+    for mode in ("negotiation", "replay"):
+        for fanout in (0, 2):
+            for victim in (1, 6):
+                rec = run_straggler_drill(
+                    mode=mode, ranks=8, victim=victim, delay_ms=25.0,
+                    seed=victim, fanout=fanout)
+                assert rec["ok"], (mode, fanout, victim, rec)

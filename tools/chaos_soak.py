@@ -222,7 +222,9 @@ def soak_knobs(stall_shutdown_s: float,
                liveness_timeout_s: float = 0.0,
                reconnect_grace_s: float = 0.0,
                coord_fanout: int = 0,
-               tune: bool = False) -> Knobs:
+               tune: bool = False,
+               metrics_agg_s: float = 0.0,
+               replay: bool = True) -> Knobs:
     """Robustness machinery tightened to soak time scales: a dropped
     frame must surface through stall shutdown in seconds, not the
     production 60s.  MTTR/liveness drills additionally arm HB
@@ -242,6 +244,8 @@ def soak_knobs(stall_shutdown_s: float,
         reconnect_grace_s=reconnect_grace_s,
         coord_fanout=coord_fanout,
         tune=tune,
+        metrics_agg_interval_s=metrics_agg_s,
+        replay_enabled=replay,
         tune_strategy="grid",
         tune_cycles_per_sample=2,
         tune_warmup_windows=1,
@@ -258,7 +262,9 @@ class ChaosWorld:
                  liveness_interval_s: float = 0.0,
                  reconnect_grace_s: float = 0.0,
                  fanout: int = 0,
-                 tune: bool = False):
+                 tune: bool = False,
+                 metrics_agg_s: float = 0.0,
+                 replay: bool = True):
         from horovod_tpu.common import relay as relay_mod
         from horovod_tpu.common.runtime import BackgroundRuntime
 
@@ -289,7 +295,9 @@ class ChaosWorld:
                            liveness_interval_s=liveness_interval_s,
                            reconnect_grace_s=reconnect_grace_s,
                            coord_fanout=fanout,
-                           tune=tune)
+                           tune=tune,
+                           metrics_agg_s=metrics_agg_s,
+                           replay=replay)
         self.runtimes = []
         try:
             # rank 0 first: it hosts the coordinator ...
@@ -909,6 +917,265 @@ def run_replay_kill_drill(ranks: int = 8, seed: int = 0,
         "ok": ok,
         "elapsed_s": round(time.monotonic() - t_start, 3),
     }
+
+
+# ---------------------------------------------------------------------------
+# straggler-attribution drill (common/straggler.py)
+# ---------------------------------------------------------------------------
+
+def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
+                        victim: int = 3, delay_ms: float = 25.0,
+                        seed: int = 0,
+                        attribution_timeout_s: float = 15.0,
+                        fanout: int = 0,
+                        hang_timeout_s: float = 20.0,
+                        threshold: float = 4.0,
+                        min_lag_s: float = 0.004,
+                        serve_status: bool = False) -> dict:
+    """One rank is made slow via the failpoint grammar
+    (``runtime.submit=delay(...)`` — a replay-safe site, so the frozen
+    schedule stays engaged while the rank stays slow) and the live
+    straggler observatory must NAME it within a bounded
+    time-to-attribution.
+
+    ``mode="negotiation"`` disables replay: attribution comes from the
+    coordinator's CH/RQ arrival-order lag EWMAs.  ``mode="replay"``
+    waits for the frozen schedule to engage on EVERY rank, then wipes
+    the scorer's negotiation-era state so the re-naming can only come
+    from the MR-carried per-rank phase summaries (the wait-inversion
+    source) — proving attribution survives the wire going dark, while
+    ``hvd_steady_state_cycles_replayed`` keeps growing and the slow
+    rank never forces a replay exit.
+
+    ``serve_status=True`` additionally serves a /status endpoint from
+    the live world and renders it through ``tools/hvdtop.py --once``
+    (the e2e acceptance path)."""
+    from horovod_tpu.common import metrics as _hm
+    from horovod_tpu.common import straggler as _sg
+
+    t_start = time.monotonic()
+    mode = mode.lower()
+    replay_mode = mode == "replay"
+    failpoints.reset()
+    _sg.reset()
+    saved_env = {}
+    for key, value in (("HOROVOD_STRAGGLER_THRESHOLD",
+                        repr(threshold)),
+                       ("HOROVOD_STRAGGLER_MIN_LAG", repr(min_lag_s))):
+        saved_env[key] = os.environ.get(key)
+        os.environ[key] = value
+    _sg.configure(enabled=True)
+    failpoints.configure("runtime.submit=delay(%gms,rank=%d)"
+                         % (delay_ms, victim), seed=seed)
+    cycles_c = _hm.REGISTRY.counter("hvd_steady_state_cycles_replayed")
+    cycles0 = cycles_c.value()
+    hangs, errors = [], []
+    world = None
+    status_srv = None
+    named_at = None
+    replay_engaged_at = None
+    neg_state_wiped = False
+    cycles_at_named = None
+    hvdtop_rc = None
+    hvdtop_out = ""
+    status_json = None
+    steps = 0
+    try:
+        world = ChaosWorld(ranks, stall_shutdown_s=30.0,
+                           exchange_timeout_s=hang_timeout_s,
+                           fanout=fanout,
+                           metrics_agg_s=0.25,
+                           replay=replay_mode)
+        coord = world.runtimes[0].controller.server
+        scorer = coord._straggler
+        assert scorer is not None, "scorer not armed on the coordinator"
+        deadline = t_start + attribution_timeout_s + 10.0
+        t_armed = time.monotonic()
+
+        def step_all(i: int):
+            step_errs = []
+
+            def one(rank):
+                try:
+                    world.collective(
+                        rank, "allreduce", "sgl/w",
+                        np.full((129,), _rank_value(rank, i),
+                                np.float32), i, hang_timeout_s)
+                except HangError as e:
+                    hangs.append({"rank": rank, "op": i,
+                                  "error": str(e)})
+                except Exception as e:
+                    step_errs.append({"rank": rank, "op": i,
+                                      "error": repr(e)[:300]})
+
+            ts = [threading.Thread(target=one, args=(r,), daemon=True,
+                                   name="straggler-r%d" % r)
+                  for r in range(ranks)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=2 * hang_timeout_s)
+                if t.is_alive():
+                    hangs.append({"rank": t.name, "op": i,
+                                  "error": "step thread never exited"})
+            errors.extend(step_errs)
+
+        while time.monotonic() < deadline and not hangs and not errors:
+            step_all(steps)
+            steps += 1
+            if replay_mode:
+                engaged = all(
+                    rt.replay is not None and
+                    rt.replay.stats()["active"]
+                    for rt in world.runtimes)
+                if engaged and replay_engaged_at is None:
+                    replay_engaged_at = time.monotonic()
+                if replay_engaged_at is not None and \
+                        not neg_state_wiped:
+                    # Attribution must now come from the MR phase
+                    # frames alone: wipe every negotiation-era trace
+                    # (and the clock restarts — this measures the
+                    # replay-mode time-to-attribution).
+                    with scorer._lock:
+                        scorer._lag.clear()
+                        scorer._wait.clear()
+                        scorer._scores.clear()
+                        scorer._flagged.clear()
+                    neg_state_wiped = True
+                    # The replay-mode TTA clock starts HERE — so must
+                    # its budget: replay engagement time on a loaded
+                    # core must not eat the attribution window.
+                    t_armed = time.monotonic()
+                    deadline = max(deadline,
+                                   t_armed + attribution_timeout_s)
+                if not neg_state_wiped:
+                    continue
+            top = scorer.top()
+            if top is not None and top[0] == victim and \
+                    victim in scorer.flagged():
+                named_at = time.monotonic()
+                cycles_at_named = cycles_c.value() - cycles0
+                break
+        # Let replay keep running a moment to prove the slow rank
+        # never forces an exit while scores stay current.
+        post_cycles = None
+        if replay_mode and named_at is not None:
+            for i in range(steps, steps + 4):
+                step_all(i)
+            steps += 4
+            post_cycles = cycles_c.value() - cycles0
+        replay_active_end = [
+            bool(rt.replay is not None and
+                 rt.replay.stats()["active"])
+            for rt in world.runtimes]
+        # Capture the verdict data BEFORE world.close(): teardown
+        # kills ranks, whose lost-promotions call scorer.drop_rank —
+        # a post-close read would see cleared scores/flags/gauges.
+        final_scores = scorer.scores()
+        victim_score = final_scores.get(victim, 0.0)
+        # Negotiation mode must be named by the ARRIVAL-LAG source
+        # alone: the wait-inversion source (MR phase frames) is also
+        # live — as in production — and could mask a broken
+        # note_arrival path, making the per-mode distinction vacuous.
+        # Recompute the lag-only score from the scorer's own EWMAs
+        # and require it to cross too.
+        lag_named = None
+        if not replay_mode and named_at is not None:
+            lags = {int(r): v for r, v in
+                    scorer.snapshot()["lag_ewma_s"].items()}
+            if lags:
+                vals = sorted(lags.values())
+                base = max(vals[len(vals) // 2], min_lag_s)
+                lag_named = lags.get(victim, 0.0) / base >= threshold
+        if serve_status and named_at is not None:
+            from horovod_tpu.common import metrics as _hm2
+
+            def status_provider(_coord=coord, _rt=world.runtimes[0]):
+                return {
+                    "rank": 0, "size": ranks, "initialized": True,
+                    "straggler_armed": True,
+                    "replay": {
+                        "enabled": replay_mode,
+                        "active": bool(
+                            _rt.replay is not None and
+                            _rt.replay.stats()["active"]),
+                        "cycles_replayed":
+                            cycles_c.value() - cycles0,
+                    },
+                    "queue_depth": _rt.tensor_queue.outstanding(),
+                    "cluster": _coord.status(),
+                }
+
+            status_srv = _hm2.serve(port=0, secret="",
+                                    status_provider=status_provider)
+            status_json = status_provider()
+            import contextlib
+            import io
+            _root = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            if _root not in sys.path:
+                sys.path.insert(0, _root)
+            from tools import hvdtop
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                hvdtop_rc = hvdtop.main(
+                    ["--once",
+                     "--url", "http://127.0.0.1:%d" % status_srv.port])
+            hvdtop_out = buf.getvalue()
+    finally:
+        if status_srv is not None:
+            try:
+                status_srv.stop()
+            except Exception:
+                pass
+        if world is not None:
+            world.close()
+        failpoints.reset()
+        _sg.reset()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    tta = (named_at - t_armed) if named_at is not None else None
+    ok = (named_at is not None and not hangs and not errors
+          and victim_score >= threshold)
+    if not replay_mode:
+        ok = ok and bool(lag_named)
+    if replay_mode:
+        ok = ok and replay_engaged_at is not None \
+            and (cycles_at_named or 0) > 0 \
+            and (post_cycles or 0) > (cycles_at_named or 0) \
+            and all(replay_active_end)
+    if serve_status and named_at is not None:
+        ok = ok and hvdtop_rc == 0 and ("SLOW" in hvdtop_out)
+    out = {
+        "kind": "straggler_drill", "mode": mode, "ranks": ranks,
+        "fanout": fanout, "victim": victim, "delay_ms": delay_ms,
+        "seed": seed, "steps": steps,
+        "named": named_at is not None,
+        "named_by_lag_source": lag_named,
+        "tta_s": round(tta, 3) if tta is not None else None,
+        "victim_score": round(victim_score, 3),
+        "threshold": threshold,
+        "scores": {str(r): round(s, 3)
+                   for r, s in sorted(final_scores.items())},
+        "hangs": hangs, "errors": errors,
+        "ok": ok,
+        "elapsed_s": round(time.monotonic() - t_start, 3),
+    }
+    if replay_mode:
+        out["replay"] = {
+            "engaged": replay_engaged_at is not None,
+            "cycles_replayed_at_named": cycles_at_named,
+            "cycles_replayed_after": post_cycles,
+            "active_at_end": replay_active_end,
+        }
+    if serve_status:
+        out["hvdtop_rc"] = hvdtop_rc
+        out["hvdtop_lines"] = hvdtop_out.splitlines()[:16]
+        out["status"] = status_json
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,14 @@ registry lock and allocates — in a hot module it must happen once at
 module scope (the pre-bound ``_FRAMES_RECV = metrics.counter(...)``
 idiom), never per call.
 
+Observability ``note_*`` feeders (the straggler observatory's phase
+collector and scorer, replay's disruption notes) follow the same
+contract with an object-shaped gate: the call must sit behind either
+an ``ENABLED`` check of the straggler module or an ``is not None``
+guard on the collector/scorer — both one attribute check on the
+disabled path.  A bare ``x.note_*(...)`` in a hot module pays the
+full call even when the subsystem is off.
+
 Hot modules are marked, not listed: a module participates by carrying
 ``# hvdlint-module: hot-path`` near its top.  Suppression for a
 genuinely cold call inside a hot module:
@@ -89,6 +97,37 @@ def _guarded(call: ast.Call, parents, aliases) -> bool:
     return False
 
 
+def _contains_isnot(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Compare) and
+               any(isinstance(op, ast.IsNot) for op in sub.ops)
+               for sub in ast.walk(node))
+
+
+def _none_guarded(call: ast.Call, parents) -> bool:
+    """True when an ancestor guard carries an ``is not None``
+    comparison evaluated before the call can run (the object-shaped
+    disabled gate: ``if sg is not None: sg.note_arrival(...)``)."""
+    prev: ast.AST = call
+    for anc in ancestors(call, parents):
+        if isinstance(anc, (ast.If, ast.While)) and \
+                _contains_isnot(anc.test) and \
+                any(stmt is prev for stmt in anc.body):
+            return True
+        if isinstance(anc, ast.IfExp) and \
+                _contains_isnot(anc.test) and anc.body is prev:
+            return True
+        if isinstance(anc, ast.BoolOp) and \
+                isinstance(anc.op, ast.And):
+            call_idx = next((i for i, v in enumerate(anc.values)
+                             if _contains(v, call)), None)
+            if call_idx is not None and any(
+                    _contains_isnot(v)
+                    for v in anc.values[:call_idx]):
+                return True
+        prev = anc
+    return False
+
+
 def _check_file(src: SourceFile) -> List[Violation]:
     out: List[Violation] = []
     if src.tree is None or not _is_hot(src):
@@ -97,6 +136,7 @@ def _check_file(src: SourceFile) -> List[Violation]:
     fr_aliases = set(import_aliases(src.tree, "flight_recorder"))
     fp_aliases = set(import_aliases(src.tree, "failpoints"))
     metric_aliases = set(import_aliases(src.tree, "metrics"))
+    sg_aliases = set(import_aliases(src.tree, "straggler"))
 
     def in_function(node) -> bool:
         return any(isinstance(a, (ast.FunctionDef,
@@ -127,6 +167,18 @@ def _check_file(src: SourceFile) -> List[Violation]:
                 "failpoints.maybe_fail() not behind `if %s.ENABLED"
                 "...` — the disabled hot path must cost one attribute "
                 "check" % owner.id))
+        elif attr.startswith("note_") and owner.id != "self" and \
+                not _guarded(node, parents, sg_aliases) and \
+                not _none_guarded(node, parents) and \
+                not src.annotated(node, TAG):
+            # owner "self" is the subsystem's own internal dispatch
+            # (e.g. replay routing on_broken through note_disruption),
+            # not a hot-path feeder site.
+            out.append(Violation(
+                CHECK, src.relpath, node.lineno, "unguarded-note",
+                "%s.%s() not behind an ENABLED / `is not None` gate "
+                "— the disabled hot path must cost one attribute "
+                "check" % (owner.id, attr)))
         elif owner.id in metric_aliases and attr in _REG_CALLS and \
                 in_function(node) and not src.annotated(node, TAG):
             out.append(Violation(
