@@ -931,6 +931,95 @@ def check_recovery_regression(out: dict, repo_dir: str):
                  cur_mttr, tol_pct), file=sys.stderr)
 
 
+def bench_autoscale(args, smoke: bool) -> dict:
+    """Autoscale latency with a number on it: the closed-loop
+    elasticity drill (policy scale-up -> checkpoint-first straggler
+    migration -> shrink, tools/chaos_soak.run_autoscale_drill)
+    repeated with the synthetic signal source; the artifact records
+    the decision -> admitted -> first-post-resize-step breakdown and
+    its p50 headline — the elasticity analog of the MTTR lane."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from chaos_soak import _percentile, run_autoscale_drill
+
+    reps = 2 if smoke else 4
+    cells = []
+    for rep in range(reps):
+        cells.append(run_autoscale_drill(
+            ranks=8, grow_to=16, seed=rep, policy_window=3,
+            policy_cooldown_s=1.0, migrate_after_s=0.2))
+
+    def lane(key, phase):
+        vals = [(c.get(key) or {}).get(phase) for c in cells]
+        vals = [v for v in vals if v is not None]
+        return {"p50_ms": round(1e3 * _percentile(vals, 50), 1)
+                if vals else None,
+                "max_ms": round(1e3 * max(vals), 1) if vals else None}
+
+    from horovod_tpu.common import metrics as _hm
+    snap = _hm.snapshot()
+    return {
+        "ranks": 8, "grow_to": 16, "cells": len(cells),
+        "cells_ok": all(c.get("ok") for c in cells),
+        # The headline: scale-up decision -> first post-resize step.
+        "autoscale_ms": lane("scale_up_s", "first_step"),
+        "scale_up_ms": {phase: lane("scale_up_s", phase)
+                        for phase in ("decision", "admission",
+                                      "first_step")},
+        "migrate_ms": {phase: lane("migrate_s", phase)
+                       for phase in ("decision", "ckpt_wait",
+                                     "first_step")},
+        "step_loss_max": max(
+            [max(c.get("step_loss_a", 0), c.get("step_loss_b", 0))
+             for c in cells] or [None]),
+        "postmortem_named_triggers_all": all(
+            (c.get("postmortem") or {}).get("named_resize_triggers")
+            for c in cells),
+        "metrics": {
+            "hvd_autoscale_seconds": snap.get("histograms", {}).get(
+                "hvd_autoscale_seconds"),
+            "hvd_elastic_resizes_total": snap.get(
+                "counters", {}).get("hvd_elastic_resizes_total"),
+        },
+    }
+
+
+def check_autoscale_regression(out: dict, repo_dir: str):
+    """The autoscale headline (scale-up decision -> first post-resize
+    step p50) is regression-warned against the prior round's artifact,
+    same contract as the MTTR lane."""
+    cur = out.get("autoscale") or {}
+    if not cur or "error" in cur:
+        return
+    if not cur.get("cells_ok"):
+        print("WARNING: autoscale drill cells failed — the closed "
+              "elasticity loop is broken, not just slow",
+              file=sys.stderr)
+    cur_p50 = (cur.get("autoscale_ms") or {}).get("p50_ms")
+    if cur_p50 is None:
+        return
+    prior = _prior_bench_value(
+        repo_dir, r'"autoscale\\?":\s*\{.*?"autoscale_ms\\?":\s*'
+                  r'\{[^}]*?"p50_ms\\?":\s*(-?[0-9.]+)')
+    if prior is None:
+        return  # first round with an autoscale lane
+    prior_ms, prior_source = prior
+    tol_pct = 30.0  # wall-clock drill on a shared CPU: wide noise band
+    delta_pct = (cur_p50 - prior_ms) / prior_ms * 100.0
+    cur["autoscale_vs_prior"] = {
+        "prior_p50_ms": prior_ms,
+        "prior_source": prior_source,
+        "delta_pct": round(delta_pct, 1),
+        "tolerance_pct": tol_pct,
+        "regressed": delta_pct > tol_pct,
+    }
+    if cur["autoscale_vs_prior"]["regressed"]:
+        print("WARNING: p50 autoscale latency regressed %.1f%% vs %s "
+              "(%.0f ms -> %.0f ms), beyond the %.0f%% noise band"
+              % (delta_pct, prior_source, prior_ms, cur_p50, tol_pct),
+              file=sys.stderr)
+
+
 # ---------------------------------------------------------------------------
 # Eager allreduce micro-benchmark (2 real processes, real control plane)
 # ---------------------------------------------------------------------------
@@ -2285,8 +2374,9 @@ def main():
     p.add_argument("--only",
                choices=["resnet", "bert", "keras",
                         "collectives", "checkpoint", "scale",
-                        "recovery", "dlrm", "coordscale",
-                        "blackbox", "tune", "straggler"],
+                        "recovery", "autoscale", "dlrm",
+                        "coordscale", "blackbox", "tune",
+                        "straggler"],
                    default=None)
     args = p.parse_args()
 
@@ -2340,8 +2430,8 @@ def main():
 
     run = {args.only} if args.only else {"resnet", "bert", "keras",
                                      "collectives", "checkpoint",
-                                     "scale", "recovery", "dlrm",
-                                     "coordscale", "blackbox",
+                                     "scale", "recovery", "autoscale",
+                                     "dlrm", "coordscale", "blackbox",
                                      "tune", "straggler"}
 
     resnet = {}
@@ -2404,6 +2494,13 @@ def main():
         except Exception as e:
             out["recovery"] = {"error": repr(e)[:300]}
         check_recovery_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "autoscale" in run:
+        try:
+            out["autoscale"] = bench_autoscale(args, args.smoke)
+        except Exception as e:
+            out["autoscale"] = {"error": repr(e)[:300]}
+        check_autoscale_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
     if "dlrm" in run:
         try:

@@ -237,6 +237,112 @@ HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
 # --- elastic --------------------------------------------------------------
 HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
 HOROVOD_HOSTNAME_KEY = HOROVOD_HOSTNAME
+# Closed-loop elasticity (runner/elastic, docs/failure_recovery.md
+# "Autoscaling").  Scale-up admission: when enabled (default), hosts
+# discovered AFTER the initial formation are admitted mid-job — the
+# driver holds them pending until the policy engine approves, then
+# bumps the discovery generation so workers re-rendezvous into the
+# grown world.  Disabled: discovered hosts still serve as replacements
+# at the next failure-driven replan, but never trigger a resize on
+# their own.
+HOROVOD_ELASTIC_SCALE_UP = "HOROVOD_ELASTIC_SCALE_UP"
+# Blacklist cooldown (seconds): a host evicted for a failure is
+# re-admitted after base * 2^(strikes-1) seconds (decaying
+# re-admission — each repeat offense doubles the sit-out, capped at
+# 2^6 ≈ 64x).  0 (default) = permanent blacklist (legacy behavior).
+HOROVOD_ELASTIC_BLACKLIST_COOLDOWN = "HOROVOD_ELASTIC_BLACKLIST_COOLDOWN"
+BLACKLIST_COOLDOWN_DEFAULT = 0.0
+BLACKLIST_MAX_STRIKE_DOUBLINGS = 6
+# Bound on one --host-discovery-script execution: a hung script times
+# out after this many seconds, the driver logs ONCE and keeps the
+# last-good host set (the start_timeout()-style fresh-parse contract).
+HOROVOD_ELASTIC_DISCOVERY_TIMEOUT = "HOROVOD_ELASTIC_DISCOVERY_TIMEOUT"
+DISCOVERY_TIMEOUT_DEFAULT = 10.0
+# Policy engine (runner/elastic/policy.py): resize decisions from the
+# aggregated signals (pending hosts, straggler scores, cycle time /
+# queue depth / steps-per-s) instead of only from deaths.  WINDOW is
+# the hysteresis — a condition must hold for this many CONSECUTIVE
+# observation ticks before a decision fires; COOLDOWN is the refractory
+# period after any decision during which no new one fires (together
+# they make flapping structurally impossible).
+HOROVOD_ELASTIC_POLICY = "HOROVOD_ELASTIC_POLICY"
+HOROVOD_ELASTIC_POLICY_WINDOW = "HOROVOD_ELASTIC_POLICY_WINDOW"
+POLICY_WINDOW_DEFAULT = 3
+HOROVOD_ELASTIC_POLICY_COOLDOWN = "HOROVOD_ELASTIC_POLICY_COOLDOWN"
+POLICY_COOLDOWN_DEFAULT = 30.0
+# Verdict-driven pre-emptive migration: act on the straggler
+# observatory's elastic/slow-<rank> publications (slow-vs-dead: a rank
+# with a ``lost`` notice is dead and owned by the eviction path; a
+# ``slow`` notice means alive-but-lagging).  A rank persistently
+# flagged for MIGRATE_AFTER seconds is checkpoint-then-evicted: the
+# driver waits (bounded by MIGRATE_CKPT_WAIT) for ckpt/latest to
+# advance past the decision point, then evicts the host BEFORE the
+# stall clock would have fired.
+HOROVOD_STRAGGLER_MIGRATE = "HOROVOD_STRAGGLER_MIGRATE"
+HOROVOD_STRAGGLER_MIGRATE_AFTER = "HOROVOD_STRAGGLER_MIGRATE_AFTER"
+STRAGGLER_MIGRATE_AFTER_DEFAULT = 10.0
+HOROVOD_STRAGGLER_MIGRATE_CKPT_WAIT = "HOROVOD_STRAGGLER_MIGRATE_CKPT_WAIT"
+STRAGGLER_MIGRATE_CKPT_WAIT_DEFAULT = 30.0
+
+
+def elastic_scale_up_enabled() -> bool:
+    """Mid-job scale-up admission gate, parsed freshly (drills and
+    tests flip it per phase)."""
+    return env_bool(HOROVOD_ELASTIC_SCALE_UP, True)
+
+
+def blacklist_cooldown() -> float:
+    """Base blacklist cooldown in seconds (0 = permanent), parsed
+    freshly on every eviction."""
+    return max(0.0, env_float(HOROVOD_ELASTIC_BLACKLIST_COOLDOWN,
+                              BLACKLIST_COOLDOWN_DEFAULT))
+
+
+def discovery_timeout() -> float:
+    """Deadline for one host-discovery-script execution, seconds."""
+    return max(0.1, env_float(HOROVOD_ELASTIC_DISCOVERY_TIMEOUT,
+                              DISCOVERY_TIMEOUT_DEFAULT))
+
+
+def policy_enabled() -> bool:
+    """Policy-engine gate (default on: with it off, the driver falls
+    back to the legacy react-only behavior — deaths shrink, discovery
+    growth is admitted immediately with no hysteresis)."""
+    return env_bool(HOROVOD_ELASTIC_POLICY, True)
+
+
+def policy_window() -> int:
+    """Hysteresis window: consecutive agreeing observation ticks
+    required before the policy engine fires a decision."""
+    return max(1, env_int(HOROVOD_ELASTIC_POLICY_WINDOW,
+                          POLICY_WINDOW_DEFAULT))
+
+
+def policy_cooldown() -> float:
+    """Refractory period (seconds) after any resize decision."""
+    return max(0.0, env_float(HOROVOD_ELASTIC_POLICY_COOLDOWN,
+                              POLICY_COOLDOWN_DEFAULT))
+
+
+def straggler_migrate_enabled() -> bool:
+    """Pre-emptive straggler migration gate (default off: acting on
+    scores is a policy choice, observing them is not)."""
+    return env_bool(HOROVOD_STRAGGLER_MIGRATE, False)
+
+
+def straggler_migrate_after() -> float:
+    """Seconds a rank must stay flagged slow before the migration
+    decision fires (persistence, not a single spike)."""
+    return max(0.0, env_float(HOROVOD_STRAGGLER_MIGRATE_AFTER,
+                              STRAGGLER_MIGRATE_AFTER_DEFAULT))
+
+
+def straggler_migrate_ckpt_wait() -> float:
+    """Bound on the checkpoint-then-evict wait for ckpt/latest to
+    advance past the migration decision (seconds); expiry evicts
+    anyway — step loss is then bounded by the checkpoint cadence."""
+    return max(0.0, env_float(HOROVOD_STRAGGLER_MIGRATE_CKPT_WAIT,
+                              STRAGGLER_MIGRATE_CKPT_WAIT_DEFAULT))
 
 # --- TPU-specific ---------------------------------------------------------
 HOROVOD_TPU_OPERATIONS = "HOROVOD_TPU_OPERATIONS"   # "XLA" (default) | "TCP"

@@ -101,6 +101,12 @@ TUNE = "tune"                # phase=search/propose/frozen/aborted
 CKPT = "ckpt"                # phase, step, outcome?
 # Elastic
 ELASTIC = "elastic"          # event, epoch?, rank?
+# Closed-loop elasticity (runner/elastic/policy.py): typed resize
+# events, so a postmortem verdict can NAME the resize trigger
+# (scale-up discovery / straggler migration / death) from the events
+# alone — tools/blackbox_merge.py maps these to verdict triggers.
+ELASTIC_SCALE_UP = "elastic_scale_up"  # hosts, slots, epoch, trigger
+ELASTIC_MIGRATE = "elastic_migrate"    # rank, host?, score, phase
 # Fault plane
 FAILPOINT = "failpoint"      # site, action
 FATAL = "fatal"              # error — this rank's world broke

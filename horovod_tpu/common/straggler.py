@@ -75,6 +75,10 @@ logger = logging.getLogger("horovod_tpu.straggler")
 ENABLED = False
 
 _EWMA_ALPHA = 0.2
+# Heartbeat period for the slow-rank hook while a rank STAYS flagged
+# (crossing fires immediately; see refresh()).  Consumers treat a
+# notice older than a few periods as "recovered".
+_SLOW_REPUBLISH_S = 2.0
 
 _SCORE = metrics.gauge(
     "hvd_straggler_score",
@@ -250,6 +254,7 @@ class StragglerScorer:
         self._neg_samples = 0
         self._last_neg_t: Optional[float] = None
         self._last_refresh_t: Optional[float] = None
+        self._last_slow_pub: Dict[int, float] = {}  # rank -> last hook t
 
     # -- feeding (coordinator frame dispatch, under the server lock) --
     def note_arrival(self, key: tuple, rank: int, t: float):
@@ -361,13 +366,30 @@ class StragglerScorer:
                 _fr.record(_fr.STRAGGLER, rank=0, role="coord",
                            peer=rank, score=round(score, 3),
                            threshold=self.threshold)
-            if self._on_slow is not None:
-                try:
-                    self._on_slow(rank, score)
-                except Exception:
-                    logger.warning("slow-rank hook failed",
-                                   exc_info=True)
+            self._fire_slow_hook(rank, score)
+        # Re-fire the hook (throttled) for ranks STILL flagged: the
+        # slow-rank KV notice is a heartbeat, not an edge — consumers
+        # (the elastic driver's migration policy) read "flagged right
+        # now" as "notice fresher than the staleness bound", so a rank
+        # that recovers simply stops being republished.  Logging and
+        # the flag counter above stay crossing-only.
+        with self._lock:
+            still = [(r, scores.get(r, 0.0)) for r in self._flagged]
+        now = time.monotonic()
+        for rank, score in still:
+            if now - self._last_slow_pub.get(rank, 0.0) >= \
+                    _SLOW_REPUBLISH_S:
+                self._fire_slow_hook(rank, score)
         return scores
+
+    def _fire_slow_hook(self, rank: int, score: float):
+        self._last_slow_pub[rank] = time.monotonic()
+        if self._on_slow is not None:
+            try:
+                self._on_slow(rank, score)
+            except Exception:
+                logger.warning("slow-rank hook failed",
+                               exc_info=True)
 
     # -- reading -------------------------------------------------------
     def top(self) -> Optional[Tuple[int, float]]:

@@ -22,12 +22,16 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import env as env_mod
 from ...common import failpoints as _fp
 from ...common import flight_recorder as _fr
 from ...common import metrics
 from ..hosts import (HostInfo, INVALID_SLOT_INFO, SlotInfo,
                      get_host_assignments)
 from .discovery import HostDiscovery, HostManager
+from .policy import (ElasticPolicy, KIND_SCALE_UP, Signals,
+                     TRIGGER_DEATH, TRIGGER_MIGRATION, TRIGGER_SCALE_UP,
+                     note_resize, observe_autoscale)
 from .registration import WorkerStateRegistry
 
 logger = logging.getLogger("horovod_tpu.elastic")
@@ -54,6 +58,14 @@ KEY_GENERATION = "generation"     # bumped on every discovery change
 # ("lost-<rank>") so correlated failures inside one poll interval
 # don't overwrite each other.
 KEY_LOST_RANK = "lost-%d"
+# Written by the rank-0 coordinator's straggler scorer (controller_net
+# _make_rank_slow_publisher) as a HEARTBEAT while a rank stays flagged
+# slow: the driver's migration policy treats a notice fresher than
+# SLOW_NOTICE_STALE_S as "flagged right now" — a recovered rank simply
+# stops being republished and its notice ages out.  A rank with a
+# LOST notice is dead, not slow; the death path owns it.
+KEY_SLOW_RANK = "slow-%d"
+SLOW_NOTICE_STALE_S = 10.0  # ~5x the scorer's republish heartbeat
 # Driver-process metrics snapshot, readable through the (job-secret
 # guarded) rendezvous HTTP server at GET /metrics/driver — the driver
 # has no worker /metrics endpoint, so the KV store is its read path.
@@ -107,6 +119,16 @@ class ElasticDriver:
         self._error_message: Optional[str] = None
         self._ckpt_latest: Optional[int] = None
         self._lost_handled: set = set()   # (epoch, rank) dedup
+
+        # Closed-loop elasticity (docs/failure_recovery.md
+        # "Autoscaling"): the policy decides WHEN to resize; the
+        # driver actuates.  _resize_trigger labels the next plan's
+        # resize for metrics + the flight-recorder verdict.
+        self._policy = ElasticPolicy(min_np, max_np)
+        self._slow_active: Dict[int, float] = {}   # rank -> score
+        self._migration: Optional[Dict] = None     # in-flight evict
+        self._resize_trigger: Optional[str] = None
+        self._last_planned_size = 0
         self._discovery_thread = threading.Thread(
             target=self._discover_hosts, name="hvd-elastic-discovery",
             daemon=True)
@@ -166,6 +188,19 @@ class ElasticDriver:
         with self._lock:
             if self._shutdown.is_set():
                 return
+            # Failure-driven resume: hosts held pending by the
+            # scale-up gate become replacements for whatever just
+            # died — backfilling LOST capacity is not growth, so it is
+            # not gated on HOROVOD_ELASTIC_SCALE_UP or the policy; the
+            # slot cap keeps it from growing past the last plan.
+            needed = self._last_planned_size - \
+                self._host_manager.available_slots()
+            if needed > 0:
+                admitted = self._host_manager.admit_pending(
+                    max_slots=needed)
+                if admitted:
+                    logger.info("elastic: admitted pending host(s) %s "
+                                "as replacements", admitted)
             if not self._wait_for_min_slots_locked():
                 return
             self._plan_epoch()
@@ -249,6 +284,30 @@ class ElasticDriver:
         self._world_size = slots[0].size if slots else 0
         _EPOCHS.inc()
         _WORLD_SIZE.set(self._world_size)
+        # Label the resize for the autoscale lane: direction from the
+        # size delta, trigger from whoever initiated it (the policy
+        # stamps _resize_trigger before actuating; an unlabeled shrink
+        # is a death, an unlabeled growth is legacy immediate-admit
+        # discovery).  Epoch 1 is formation, not a resize.
+        prev_size = self._last_planned_size
+        self._last_planned_size = self._world_size
+        trigger = self._resize_trigger
+        self._resize_trigger = None
+        if prev_size and self._world_size != prev_size:
+            direction = "up" if self._world_size > prev_size else "down"
+            if trigger is None:
+                trigger = TRIGGER_SCALE_UP if direction == "up" \
+                    else TRIGGER_DEATH
+            note_resize(direction, trigger)
+            if trigger == TRIGGER_DEATH:
+                # Post-recovery cycles are noisy; give the policy the
+                # same refractory period its own decisions get.
+                self._policy.note_external_resize()
+        else:
+            # Same-size replan (1:1 replacement) or first formation —
+            # not a resize, so no counter; the FR label still says
+            # which.
+            trigger = "formation" if prev_size == 0 else "replacement"
         assignments: Dict[str, List[SlotInfo]] = OrderedDict()
         for s in slots:
             assignments.setdefault(s.hostname, []).append(s)
@@ -284,7 +343,8 @@ class ElasticDriver:
             self._rendezvous.init(self._host_assignments)
         if _fr.ENABLED:
             _fr.record(_fr.ELASTIC, rank="driver", event="epoch_plan",
-                       epoch=self._epoch, size=self._world_size)
+                       epoch=self._epoch, size=self._world_size,
+                       trigger=trigger)
         logger.info("elastic: epoch %d planned, size=%d hosts=%s",
                     self._epoch, self._world_size, list(current.keys()))
         self._publish_metrics()
@@ -355,7 +415,6 @@ class ElasticDriver:
         newest committed checkpoint and seed the rendezvous KV's
         ``ckpt/latest`` key — the restart-from-latest-valid path after
         a whole-job preemption, where no rank remembers anything."""
-        from ...common import env as env_mod
         directory = env_mod.env_str_opt(ENV_CKPT_DIR)
         if not directory:
             return
@@ -391,27 +450,33 @@ class ElasticDriver:
         except Exception:
             logger.debug("driver metrics publish failed", exc_info=True)
 
-    def _poll_lost_ranks(self):
+    def _list_elastic_keys(self) -> Optional[set]:
+        """One ``elastic`` scope listing per discovery tick, shared by
+        the lost-rank and slow-rank polls: O(notices present), not
+        O(world) — at 64-256 ranks (relay-tree worlds) the per-slot
+        GET form was the driver's own flat-star scan.  None = no KV
+        store or a listing hiccup (both polls skip the tick)."""
+        if self._rendezvous is None or self._rendezvous.kvstore is None:
+            return None
+        try:
+            return set(self._rendezvous.kvstore.keys(ELASTIC_SCOPE))
+        except Exception:
+            logger.warning("elastic: notice listing failed; will "
+                           "retry next tick", exc_info=True)
+            return None
+
+    def _poll_lost_ranks(self, present: Optional[set] = None):
         """Act on lost-rank notices the rank-0 coordinator published:
         record the failure against the rank's slot so the registry
         barrier fires and the host is blacklisted — the eviction path
         for a wedged worker whose process never exits."""
-        if self._rendezvous is None or self._rendezvous.kvstore is None:
-            return
+        if present is None:
+            present = self._list_elastic_keys()
+            if present is None:
+                return
         with self._lock:
             slots = [s for ss in self._host_assignments.values()
                      for s in ss]
-        # One scope listing per tick instead of one GET per slot: the
-        # poll is O(notices present), not O(world) — at 64-256 ranks
-        # (relay-tree worlds) the per-slot form was the driver's own
-        # flat-star scan.  A whole subtree promoted at once (relay
-        # loss past grace) lands as several notices in one listing.
-        try:
-            present = set(self._rendezvous.kvstore.keys(ELASTIC_SCOPE))
-        except Exception:
-            logger.warning("elastic: lost-rank listing failed; will "
-                           "retry next tick", exc_info=True)
-            return
         for slot in slots:
             key = KEY_LOST_RANK % slot.rank
             if key not in present:
@@ -451,14 +516,199 @@ class ElasticDriver:
             self._registry.record_failure(slot.hostname,
                                           slot.local_rank)
 
+    def _poll_slow_ranks(self, present: Optional[set] = None):
+        """Refresh the flagged-slow view from the coordinator's
+        ``slow-<rank>`` KV heartbeats.  A notice older than
+        SLOW_NOTICE_STALE_S is a recovered rank (the scorer stopped
+        republishing); a rank with a LOST notice is dead, and the
+        death path owns it — its slow state is dropped so migration
+        never races eviction."""
+        if present is None:
+            present = self._list_elastic_keys()
+            if present is None:
+                return
+        active: Dict[int, float] = {}
+        with self._lock:
+            slots = [s for ss in self._host_assignments.values()
+                     for s in ss]
+        for slot in slots:
+            if (KEY_LOST_RANK % slot.rank) in present:
+                continue
+            key = KEY_SLOW_RANK % slot.rank
+            if key not in present:
+                continue
+            try:
+                raw = self._rendezvous.kvstore.get(ELASTIC_SCOPE, key)
+            except Exception:
+                logger.warning("elastic: slow-rank poll failed for "
+                               "rank %d; will retry next tick",
+                               slot.rank, exc_info=True)
+                continue
+            if raw is None:
+                continue
+            try:
+                notice = json.loads(raw.decode())
+                rank = int(notice["rank"])
+                score = float(notice.get("score", 0.0))
+                wall = float(notice.get("wall", 0.0))
+            except (ValueError, KeyError):
+                continue
+            if time.time() - wall > SLOW_NOTICE_STALE_S:
+                continue  # stale heartbeat: the rank recovered
+            active[rank] = score
+        self._slow_active = active
+
+    def _read_kv_ckpt_latest(self) -> Optional[int]:
+        """The newest committed checkpoint step per the coordination
+        KV (checkpoint/coordinator.py publishes it on every commit) —
+        the migration state machine's evidence that a fresh durable
+        checkpoint exists before it evicts a straggler."""
+        if self._rendezvous is None or self._rendezvous.kvstore is None:
+            return None
+        try:
+            raw = self._rendezvous.kvstore.get(CKPT_SCOPE,
+                                               KEY_CKPT_LATEST)
+            return int(raw.decode()) if raw else None
+        except Exception:
+            return None
+
+    def _policy_tick(self) -> bool:
+        """Feed the resize policy one tick of signals and actuate any
+        decision; returns True when host membership changed (caller
+        bumps the discovery generation)."""
+        if not env_mod.policy_enabled():
+            return False
+        with self._lock:
+            size = self._world_size
+        pending = len(self._host_manager.pending_hosts()) \
+            if env_mod.elastic_scale_up_enabled() else 0
+        decision = self._policy.observe(Signals(
+            size, pending_hosts=pending,
+            straggler_scores=dict(self._slow_active)))
+        if decision is None:
+            return False
+        if decision.kind == KIND_SCALE_UP:
+            return self._actuate_scale_up(decision)
+        self._start_migration(decision)
+        return False
+
+    def _actuate_scale_up(self, decision) -> bool:
+        t0 = time.monotonic()
+        admitted = self._host_manager.admit_pending()
+        if not admitted:
+            return False
+        with self._lock:
+            self._resize_trigger = TRIGGER_SCALE_UP
+            epoch = self._epoch
+        observe_autoscale("admission", time.monotonic() - t0)
+        if _fr.ENABLED:
+            _fr.record(_fr.ELASTIC_SCALE_UP, rank="driver",
+                       hosts=",".join(admitted), epoch=epoch,
+                       trigger=decision.trigger)
+        logger.info("elastic: scale-up admitting host(s) %s (%s)",
+                    admitted, decision.reason)
+        return True
+
+    def _start_migration(self, decision):
+        """Begin checkpoint-then-evict for a persistently slow rank:
+        remember the checkpoint step at decision time and let
+        ``_tick_migration`` evict once a NEWER commit lands (bounded
+        by HOROVOD_STRAGGLER_MIGRATE_CKPT_WAIT — a straggler slow
+        enough to stall checkpointing still gets evicted)."""
+        rank = decision.rank
+        with self._lock:
+            if self._migration is not None:
+                return  # one migration in flight at a time
+            slot = next((s for ss in self._host_assignments.values()
+                         for s in ss if s.rank == rank), None)
+            if slot is None:
+                return
+            self._migration = {
+                "rank": rank,
+                "host": slot.hostname,
+                "local_rank": slot.local_rank,
+                "epoch": self._epoch,
+                "decided": time.monotonic(),
+                "ckpt0": self._read_kv_ckpt_latest(),
+                "deadline": time.monotonic() +
+                env_mod.straggler_migrate_ckpt_wait(),
+                "score": self._slow_active.get(rank, 0.0),
+            }
+            mig = dict(self._migration)
+        if _fr.ENABLED:
+            _fr.record(_fr.ELASTIC_MIGRATE, rank="driver",
+                       peer=rank, host=mig["host"], phase="decided",
+                       score=round(mig["score"], 3))
+        logger.warning(
+            "elastic: migration decided for rank %d (%s): waiting for "
+            "a fresh checkpoint before evicting (%s)", rank,
+            mig["host"], decision.reason)
+
+    def _tick_migration(self) -> bool:
+        """Advance an in-flight migration; returns True when the
+        eviction fired (the caller bumps the generation so survivors
+        re-rendezvous — the slow rank's collectives still succeed, so
+        nothing else would make them notice)."""
+        with self._lock:
+            mig = self._migration
+            if mig is None:
+                return False
+            if mig["epoch"] != self._epoch:
+                # The world replanned under us (a death beat the
+                # migration to it) — the straggler evidence is void.
+                self._migration = None
+                return False
+        latest = self._read_kv_ckpt_latest()
+        ckpt_fresh = latest is not None and \
+            (mig["ckpt0"] is None or latest > mig["ckpt0"])
+        timed_out = time.monotonic() >= mig["deadline"]
+        if not ckpt_fresh and not timed_out:
+            return False
+        with self._lock:
+            self._migration = None
+            self._resize_trigger = TRIGGER_MIGRATION
+        observe_autoscale("admission",
+                          time.monotonic() - mig["decided"])
+        if _fr.ENABLED:
+            _fr.record(_fr.ELASTIC_MIGRATE, rank="driver",
+                       peer=mig["rank"], host=mig["host"],
+                       phase="evict",
+                       ckpt_step=latest if latest is not None else -1,
+                       ckpt_fresh=ckpt_fresh)
+        logger.warning(
+            "elastic: evicting straggler rank %d host %s (%s)",
+            mig["rank"], mig["host"],
+            "checkpoint %s committed" % latest if ckpt_fresh
+            else "checkpoint wait timed out")
+        # FAILURE is sticky in the registry, so the (alive) slow
+        # worker's own re-rendezvous cannot resurrect the slot; the
+        # barrier then blacklists the host (decaying cooldown) and
+        # resume() replans without it.
+        self._registry.record_failure(mig["host"], mig["local_rank"])
+        return True
+
     def _discover_hosts(self):
         while not self._shutdown.is_set():
+            # With the policy engine armed, newly discovered hosts
+            # are held PENDING and admitted only on a policy decision
+            # (or as failure replacements in resume()); legacy
+            # immediate growth survives as policy-off + scale-up-on.
+            admit_new = env_mod.elastic_scale_up_enabled() and \
+                not env_mod.policy_enabled()
             try:
-                changed = self._host_manager.update_available_hosts()
+                changed = self._host_manager.update_available_hosts(
+                    admit_new=admit_new)
             except Exception:
                 logger.exception("host discovery failed; retrying")
                 changed = False
-            self._poll_lost_ranks()
+            present = self._list_elastic_keys()
+            if present is not None:
+                self._poll_lost_ranks(present)
+                self._poll_slow_ranks(present)
+            if self._tick_migration():
+                changed = True
+            if self._policy_tick():
+                changed = True
             self._publish_metrics()
             if changed:
                 with self._lock:

@@ -88,6 +88,13 @@ class WorkerStateRegistry:
             cur = self._states.get(key)
             if cur == state:
                 return self._rendezvous_id
+            if cur == FAILURE and state == READY:
+                # FAILURE is sticky within an epoch: the driver
+                # records it for a straggler being migrated while the
+                # worker process is still alive — the worker's own
+                # re-rendezvous must not resurrect the slot, or the
+                # eviction evaporates at the barrier.
+                return self._rendezvous_id
             if cur is not None:
                 # A worker moves READY -> SUCCESS/FAILURE within one
                 # epoch; replace its recorded state without re-counting.

@@ -1,0 +1,201 @@
+"""Unit tests for elastic host discovery (runner/elastic/discovery.py):
+HostDiscoveryScript edge cases (duplicates, slot changes,
+removed-then-re-added hosts, empty/failed/hung output), the decaying
+blacklist cooldown ladder, and pending-host scale-up admission."""
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (FixedHosts,
+                                                  HostDiscoveryScript,
+                                                  HostManager)
+
+
+class MutableDiscovery(FixedHosts):
+    def set(self, host_slots):
+        self._host_slots = dict(host_slots)
+
+
+# -- HostDiscoveryScript edge cases -----------------------------------
+
+
+def make_script(tmp_path, lines):
+    """A discovery 'script' that cats a host file we can rewrite
+    between polls (the command string itself never changes)."""
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("\n".join(lines) + ("\n" if lines else ""))
+    return hosts, HostDiscoveryScript("cat %s" % hosts, 2)
+
+
+def test_script_parses_slots_defaults_and_duplicates(tmp_path):
+    _, disc = make_script(tmp_path, ["a", "a", "b:3", "", "c:bogus",
+                                     "d"])
+    # Duplicates collapse, explicit slots parse, junk slot counts and
+    # blank lines are skipped, bare hosts get the default.
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 3,
+                                                     "d": 2}
+
+
+def test_script_slot_change_on_existing_host(tmp_path):
+    hosts, disc = make_script(tmp_path, ["a:2", "b:2"])
+    hm = HostManager(disc)
+    hm.update_available_hosts()
+    assert dict(hm.current_hosts) == {"a": 2, "b": 2}
+    hosts.write_text("a:4\nb:2\n")
+    assert hm.update_available_hosts()
+    assert dict(hm.current_hosts) == {"a": 4, "b": 2}
+    assert hm.available_slots() == 6
+
+
+def test_script_host_removed_then_re_added(tmp_path):
+    hosts, disc = make_script(tmp_path, ["a:2", "b:2"])
+    hm = HostManager(disc)
+    hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["a", "b"]
+    hosts.write_text("a:2\n")
+    assert hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["a"]
+    # Re-added host appends — surviving ranks keep their order.
+    hosts.write_text("b:2\na:2\n")
+    assert hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["a", "b"]
+
+
+def test_script_empty_output_keeps_last_good(tmp_path):
+    hosts, disc = make_script(tmp_path, ["a:2", "b:2"])
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+    # A flaky script printing nothing must NOT read as "every host
+    # left at once".
+    hosts.write_text("")
+    assert disc.find_available_hosts_and_slots() == {"a": 2, "b": 2}
+    # Healthy again: the real listing (including a real removal)
+    # applies.
+    hosts.write_text("a:2\n")
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+
+
+def test_script_failure_keeps_last_good(tmp_path):
+    hosts, disc = make_script(tmp_path, ["a:2"])
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+    hosts.unlink()  # cat exits non-zero
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+
+
+def test_script_empty_at_formation_raises():
+    disc = HostDiscoveryScript("true", 2)  # exits 0, prints nothing
+    with pytest.raises(RuntimeError):
+        disc.find_available_hosts_and_slots()
+
+
+def test_script_timeout_falls_back_to_last_good(tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv("HOROVOD_ELASTIC_DISCOVERY_TIMEOUT", "0.2")
+    flag = tmp_path / "hang"
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("a:2\n")
+    disc = HostDiscoveryScript(
+        "if [ -f %s ]; then sleep 5; fi; cat %s" % (flag, hosts), 2)
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+    flag.write_text("")  # now the script hangs past the timeout
+    assert disc.find_available_hosts_and_slots() == {"a": 2}
+    # ...and with no last-good set a hang is a hard error.
+    fresh = HostDiscoveryScript("sleep 5", 2)
+    with pytest.raises(RuntimeError):
+        fresh.find_available_hosts_and_slots()
+
+
+# -- blacklist cooldown ladder ----------------------------------------
+
+
+def test_blacklist_cooldown_ladder_and_readmission():
+    clock = [0.0]
+    disc = MutableDiscovery({"a": 1, "b": 1})
+    hm = HostManager(disc, cooldown_s=10.0, now=lambda: clock[0])
+    hm.update_available_hosts()
+    hm.blacklist("a")
+    assert hm.is_blacklisted("a")
+    strikes, remaining = hm.blacklist_info("a")
+    assert strikes == 1 and remaining == pytest.approx(10.0)
+    # Cooldown elapses: re-admittable via the normal append path.
+    clock[0] = 10.0
+    assert not hm.is_blacklisted("a")
+    hm.update_available_hosts()
+    assert list(hm.current_hosts) == ["b", "a"]
+    # Second strike doubles the sit-out.
+    hm.blacklist("a")
+    strikes, remaining = hm.blacklist_info("a")
+    assert strikes == 2 and remaining == pytest.approx(20.0)
+    clock[0] = 29.0
+    assert hm.is_blacklisted("a")
+    clock[0] = 30.0
+    assert not hm.is_blacklisted("a")
+
+
+def test_blacklist_zero_cooldown_is_permanent():
+    clock = [0.0]
+    hm = HostManager(MutableDiscovery({"a": 1}), cooldown_s=0.0,
+                     now=lambda: clock[0])
+    hm.update_available_hosts()
+    hm.blacklist("a")
+    strikes, remaining = hm.blacklist_info("a")
+    assert strikes == 1 and remaining is None
+    clock[0] = 1e9
+    assert hm.is_blacklisted("a")
+
+
+def test_blacklist_doubling_is_capped():
+    from horovod_tpu.common.env import BLACKLIST_MAX_STRIKE_DOUBLINGS
+    clock = [0.0]
+    hm = HostManager(MutableDiscovery({"a": 1}), cooldown_s=1.0,
+                     now=lambda: clock[0])
+    for _ in range(BLACKLIST_MAX_STRIKE_DOUBLINGS + 5):
+        hm.blacklist("a")
+        _, remaining = hm.blacklist_info("a")
+        clock[0] += remaining  # serve out the sit-out exactly
+        assert not hm.is_blacklisted("a")
+    hm.blacklist("a")
+    _, remaining = hm.blacklist_info("a")
+    assert remaining == pytest.approx(
+        2 ** BLACKLIST_MAX_STRIKE_DOUBLINGS)
+
+
+# -- pending-host scale-up admission ----------------------------------
+
+
+def test_admit_new_false_holds_pending():
+    disc = MutableDiscovery({"a": 2})
+    hm = HostManager(disc)
+    hm.update_available_hosts()
+    disc.set({"a": 2, "b": 2, "c": 2})
+    # The current set does not change: the new hosts are held.
+    assert not hm.update_available_hosts(admit_new=False)
+    assert list(hm.pending_hosts()) == ["b", "c"]
+    assert hm.available_slots() == 2
+    admitted = hm.admit_pending(max_slots=2)
+    assert admitted == ["b"]
+    assert list(hm.current_hosts) == ["a", "b"]
+    assert list(hm.pending_hosts()) == ["c"]
+    assert hm.admit_pending() == ["c"]
+    assert hm.available_slots() == 6
+
+
+def test_admit_new_false_still_applies_removals_and_slots():
+    disc = MutableDiscovery({"a": 2, "b": 2})
+    hm = HostManager(disc)
+    hm.update_available_hosts()
+    disc.set({"a": 4, "c": 2})
+    assert hm.update_available_hosts(admit_new=False)
+    assert dict(hm.current_hosts) == {"a": 4}
+    assert list(hm.pending_hosts()) == ["c"]
+
+
+def test_blacklisted_host_never_admitted_from_pending():
+    disc = MutableDiscovery({"a": 2})
+    hm = HostManager(disc)
+    hm.update_available_hosts()
+    disc.set({"a": 2, "b": 2})
+    hm.update_available_hosts(admit_new=False)
+    assert list(hm.pending_hosts()) == ["b"]
+    hm.blacklist("b")
+    assert hm.pending_hosts() == {}
+    assert hm.admit_pending() == []
+    assert list(hm.current_hosts) == ["a"]

@@ -166,6 +166,123 @@ def test_driver_host_added_grows_world():
     driver.stop()
 
 
+def test_driver_scale_up_gate_holds_pending(monkeypatch):
+    """With HOROVOD_ELASTIC_SCALE_UP=0 a newly discovered host is held
+    pending — it never grows the world on its own (it remains a
+    replacement candidate for the next failure-driven replan)."""
+    monkeypatch.setenv("HOROVOD_ELASTIC_SCALE_UP", "0")
+    workers = FakeWorkers()
+    disc = MutableDiscovery({"a": 2})
+    driver = make_driver(disc, min_np=2)
+    driver.start(2, workers.create)
+    time.sleep(0.2)
+    disc.set({"a": 2, "b": 2})
+    deadline = time.monotonic() + 5
+    while "b" not in driver.host_manager.pending_hosts() and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert "b" in driver.host_manager.pending_hosts()
+    assert driver.host_manager.available_slots() == 2
+    assert driver.epoch == 1
+    workers.release_all(0)
+    driver.stop()
+
+
+def test_driver_policy_off_immediate_growth(monkeypatch):
+    """Legacy growth path: with the policy engine disabled (and
+    scale-up on), a discovered host is admitted on the next discovery
+    tick with no hysteresis window."""
+    monkeypatch.setenv("HOROVOD_ELASTIC_POLICY", "0")
+    workers = FakeWorkers()
+    disc = MutableDiscovery({"a": 2})
+    driver = make_driver(disc, min_np=2)
+    driver.start(2, workers.create)
+    time.sleep(0.2)
+    disc.set({"a": 2, "b": 2})
+    deadline = time.monotonic() + 5
+    while driver.host_manager.available_slots() < 4 and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert driver.host_manager.available_slots() == 4
+    assert not driver.host_manager.pending_hosts()
+    workers.release_all(0)
+    driver.stop()
+
+
+def test_driver_migrates_persistently_slow_rank(monkeypatch):
+    """Verdict-driven pre-emptive migration: a fresh elastic/slow-<r>
+    KV notice feeds the policy, the decision waits checkpoint-first,
+    the eviction records the slot FAILED — and the evicted worker's
+    own re-rendezvous (it is alive, just slow) must not resurrect the
+    slot at the barrier."""
+    import json
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE", "1")
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE_AFTER", "0")
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE_CKPT_WAIT", "0")
+    workers = FakeWorkers()
+    rdv = RendezvousServer(secret="")
+    rdv.start()
+    try:
+        driver = ElasticDriver(rendezvous=rdv,
+                               discovery=FixedHosts({"a": 2}),
+                               min_np=1, timeout=5)
+        driver.start(2, workers.create)
+        rdv.kvstore.put("elastic", "slow-1", json.dumps(
+            {"rank": 1, "score": 7.5,
+             "wall": time.time()}).encode())
+        driver._poll_slow_ranks()
+        assert driver._slow_active.get(1) == 7.5
+        # The policy decides a migration (not a scale-up): decision
+        # arms the checkpoint-first eviction, it does not evict yet.
+        assert driver._policy_tick() is False
+        assert driver._migration is not None
+        assert driver._migration["rank"] == 1
+        assert not driver.registry.get_recorded("FAILURE")
+        # Ckpt-wait deadline 0: the eviction fires on the next tick
+        # and asks for a generation bump.
+        assert driver._tick_migration() is True
+        assert "a:1" in driver.registry.get_recorded("FAILURE")
+        # FAILURE is sticky within the epoch: the alive-but-evicted
+        # worker re-rendezvousing READY must not undo the eviction.
+        driver.record_ready("a", 1)
+        assert "a:1" in driver.registry.get_recorded("FAILURE")
+        driver.stop()
+        workers.release_all(0)
+    finally:
+        rdv.stop()
+
+
+def test_driver_ignores_stale_slow_notice():
+    """A slow notice whose wall clock is past SLOW_NOTICE_STALE_S is a
+    recovered rank (the scorer heartbeats fresh notices while the rank
+    stays flagged) — it must not feed the policy."""
+    import json
+
+    from horovod_tpu.runner.elastic.driver import SLOW_NOTICE_STALE_S
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    workers = FakeWorkers()
+    rdv = RendezvousServer(secret="")
+    rdv.start()
+    try:
+        driver = ElasticDriver(rendezvous=rdv,
+                               discovery=FixedHosts({"a": 2}),
+                               min_np=1, timeout=5)
+        driver.start(2, workers.create)
+        rdv.kvstore.put("elastic", "slow-1", json.dumps(
+            {"rank": 1, "score": 7.5,
+             "wall": time.time() - SLOW_NOTICE_STALE_S - 1}).encode())
+        driver._poll_slow_ranks()
+        assert driver._slow_active == {}
+        workers.release_all(0)
+        driver.stop()
+    finally:
+        rdv.stop()
+
+
 def test_all_success_stops_cleanly():
     workers = FakeWorkers()
     driver = make_driver(FixedHosts({"a": 2}), min_np=2)

@@ -1,0 +1,174 @@
+"""Unit tests for the elastic resize policy (runner/elastic/policy.py):
+hysteresis, cooldown, straggler-persistence ripening, the cycle
+stability guard, and the np bounds — all with an injected clock, no
+sleeping (docs/failure_recovery.md "Autoscaling")."""
+
+import pytest
+
+from horovod_tpu.runner.elastic.policy import (KIND_MIGRATE,
+                                               KIND_SCALE_UP,
+                                               TRIGGER_MIGRATION,
+                                               TRIGGER_SCALE_UP,
+                                               ElasticPolicy, Signals)
+
+
+def make_policy(clock, **kw):
+    kw.setdefault("window", 3)
+    kw.setdefault("cooldown_s", 30.0)
+    kw.setdefault("migrate_after_s", 10.0)
+    kw.setdefault("min_np", 2)
+    kw.setdefault("max_np", 8)
+    return ElasticPolicy(now=lambda: clock[0], **kw)
+
+
+def tick(clock, policy, signals, dt=1.0):
+    d = policy.observe(signals)
+    clock[0] += dt
+    return d
+
+
+def test_scale_up_waits_for_hysteresis_window():
+    clock = [0.0]
+    p = make_policy(clock)
+    for _ in range(2):
+        assert tick(clock, p, Signals(4, pending_hosts=1)) is None
+    d = tick(clock, p, Signals(4, pending_hosts=1))
+    assert d is not None and d.kind == KIND_SCALE_UP
+    assert d.trigger == TRIGGER_SCALE_UP
+
+
+def test_noisy_tick_resets_streak():
+    clock = [0.0]
+    p = make_policy(clock)
+    assert tick(clock, p, Signals(4, pending_hosts=1)) is None
+    assert tick(clock, p, Signals(4, pending_hosts=1)) is None
+    # Pending capacity vanishes for one tick: the count restarts.
+    assert tick(clock, p, Signals(4, pending_hosts=0)) is None
+    for _ in range(2):
+        assert tick(clock, p, Signals(4, pending_hosts=1)) is None
+    assert tick(clock, p, Signals(4, pending_hosts=1)) is not None
+
+
+def test_cooldown_is_refractory_for_any_decision():
+    clock = [0.0]
+    p = make_policy(clock)
+    for _ in range(3):
+        d = tick(clock, p, Signals(4, pending_hosts=1))
+    assert d is not None
+    # Refractory: nothing decides until the cooldown elapses, but the
+    # streak keeps accumulating underneath.
+    for _ in range(10):
+        assert tick(clock, p, Signals(4, pending_hosts=1)) is None
+    clock[0] = 40.0
+    assert p.observe(Signals(4, pending_hosts=1)) is not None
+
+
+def test_external_resize_starts_cooldown():
+    clock = [0.0]
+    p = make_policy(clock)
+    p.note_external_resize()
+    assert p.in_cooldown()
+    for _ in range(5):
+        assert tick(clock, p, Signals(4, pending_hosts=1)) is None
+
+
+def test_max_np_caps_growth():
+    clock = [0.0]
+    p = make_policy(clock)
+    for _ in range(6):
+        assert tick(clock, p, Signals(8, pending_hosts=1)) is None
+
+
+def test_cycle_instability_defers_scale_up():
+    clock = [0.0]
+    p = make_policy(clock)
+    for _ in range(2):
+        assert tick(clock, p, Signals(4, pending_hosts=1,
+                                      cycle_time_s=0.1)) is None
+    # The deciding tick regresses 5x against the median: deferred, and
+    # the streak resets (an unstable tick is a noisy tick).
+    assert tick(clock, p, Signals(4, pending_hosts=1,
+                                  cycle_time_s=0.5)) is None
+    for _ in range(2):
+        assert tick(clock, p, Signals(4, pending_hosts=1,
+                                      cycle_time_s=0.1)) is None
+    d = tick(clock, p, Signals(4, pending_hosts=1, cycle_time_s=0.1))
+    assert d is not None and d.kind == KIND_SCALE_UP
+
+
+def test_migrate_requires_persistence(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE", "1")
+    clock = [0.0]
+    p = make_policy(clock)
+    slow = Signals(4, straggler_scores={3: 7.0})
+    for _ in range(10):
+        assert tick(clock, p, slow) is None
+    # Flagged continuously for >= migrate_after_s: ripe.
+    d = tick(clock, p, slow)
+    assert d is not None and d.kind == KIND_MIGRATE
+    assert d.rank == 3 and d.trigger == TRIGGER_MIGRATION
+
+
+def test_flag_gap_resets_persistence(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE", "1")
+    clock = [0.0]
+    p = make_policy(clock)
+    slow = Signals(4, straggler_scores={3: 7.0})
+    for _ in range(8):
+        assert tick(clock, p, slow) is None
+    # The rank recovers for one tick: the persistence clock restarts,
+    # so the next 10 flagged ticks are needed again.
+    assert tick(clock, p, Signals(4)) is None
+    for _ in range(10):
+        assert tick(clock, p, slow) is None
+    assert tick(clock, p, slow) is not None
+
+
+def test_migrate_disabled_by_default():
+    clock = [0.0]
+    p = make_policy(clock)
+    slow = Signals(4, straggler_scores={3: 7.0})
+    for _ in range(20):
+        assert tick(clock, p, slow) is None
+
+
+def test_migrate_respects_min_np_floor(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE", "1")
+    clock = [0.0]
+    p = make_policy(clock)
+    # World already at the floor: evicting would undershoot min_np.
+    slow = Signals(2, straggler_scores={1: 9.0})
+    for _ in range(20):
+        assert tick(clock, p, slow) is None
+
+
+def test_migrate_picks_longest_flagged(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE", "1")
+    clock = [0.0]
+    p = make_policy(clock)
+    assert tick(clock, p, Signals(4,
+                                  straggler_scores={5: 3.0})) is None
+    both = Signals(4, straggler_scores={5: 3.0, 2: 9.0})
+    d = None
+    for _ in range(12):
+        d = p.observe(both)
+        clock[0] += 1.0
+        if d is not None:
+            break
+    assert d is not None and d.kind == KIND_MIGRATE
+    # Rank 5 was flagged first, even though rank 2 scores higher.
+    assert d.rank == 5
+
+
+def test_migrate_outranks_scale_up(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STRAGGLER_MIGRATE", "1")
+    clock = [0.0]
+    p = make_policy(clock, migrate_after_s=2.0)
+    sig = Signals(4, pending_hosts=1, straggler_scores={3: 7.0})
+    d = None
+    for _ in range(10):
+        d = p.observe(sig)
+        clock[0] += 1.0
+        if d is not None:
+            break
+    assert d is not None and d.kind == KIND_MIGRATE

@@ -69,7 +69,15 @@ STOP = os.environ["TEST_STOP_FILE"]
 
 @run
 def train(state):
-    while not os.path.exists(STOP):
+    while True:
+        # The stop decision must be COLLECTIVE: ranks polling the
+        # file independently can disagree by one epoch (one rank
+        # exits, the rest wedge on its missing contribution).
+        stop = np.asarray(hj.allreduce(
+            np.asarray([float(os.path.exists(STOP))], np.float32),
+            op=hvd.Sum, name="stopflag"))
+        if stop[0] > 0:
+            return state.epoch
         val = np.asarray(hj.allreduce(
             np.ones(4, np.float32), op=hvd.Sum,
             name=f"t{state.epoch}"))
@@ -79,7 +87,6 @@ def train(state):
         state.epoch += 1
         state.commit()
         time.sleep(0.05)
-    return state.epoch
 
 train(state)
 print(f"DONE rank={hvd.rank()} epoch={state.epoch}", flush=True)

@@ -292,6 +292,32 @@ def compute_verdict(dumps: List[dict],
     if relay_down is not None:
         failed_relay = relay_down[1].get("relay")
 
+    # Resize triggers, time-ordered: the typed elasticity events name
+    # WHY each world change happened (scale_up_discovery /
+    # straggler_migration / death).  Three event forms feed this —
+    # the driver's typed elastic_scale_up / elastic_migrate records,
+    # the coordinator-notice evictions, and the epoch_plan trigger
+    # label; an epoch_plan restating the trigger of the typed event
+    # that preceded it is collapsed.
+    resize_triggers: List[str] = []
+    for t, e, d in evs:
+        kind = e["kind"]
+        trig = None
+        if kind == "elastic_scale_up":
+            trig = "scale_up_discovery"
+        elif kind == "elastic_migrate" and e.get("phase") == "evict":
+            trig = "straggler_migration"
+        elif kind == "elastic" and e.get("event") == "evict":
+            trig = "death"
+        elif kind == "elastic" and e.get("event") == "epoch_plan" and \
+                e.get("trigger") in ("scale_up_discovery",
+                                     "straggler_migration", "death"):
+            trig = e["trigger"]
+            if resize_triggers and resize_triggers[-1] == trig:
+                trig = None
+        if trig is not None:
+            resize_triggers.append(trig)
+
     # First divergent event: the earliest (merged-time) piece of
     # evidence that some rank's view of the world stopped matching its
     # peers' — limbo entry, a relay loss, a silent-peer promotion, a
@@ -336,6 +362,9 @@ def compute_verdict(dumps: List[dict],
     return {
         "failed_rank": failed_rank,
         "failed_relay": failed_relay,
+        "resize_triggers": resize_triggers,
+        "resize_trigger": resize_triggers[-1] if resize_triggers
+        else None,
         "first_divergent_event": _ev(first_div),
         "spans": spans,
         "mttr_s": spans.get("total"),

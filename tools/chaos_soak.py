@@ -1817,7 +1817,8 @@ def _arm_blackbox() -> str:
 
 def collect_postmortem(dump_dir: str, expect_rank=None,
                        expect_relay=None,
-                       measured_mttr_s=None) -> dict:
+                       measured_mttr_s=None,
+                       expect_resize_triggers=None) -> dict:
     """Drill-end postmortem: dump the armed recorder, run
     tools/blackbox_merge.py over the per-rank dumps, validate the
     merged chrome trace, and check the verdict against what the drill
@@ -1848,6 +1849,8 @@ def collect_postmortem(dump_dir: str, expect_rank=None,
                                   ("kind", "reason", "peer", "relay")},
         "spans": verdict.get("spans"),
         "mttr_s": verdict.get("mttr_s"),
+        "resize_triggers": verdict.get("resize_triggers"),
+        "resize_trigger": verdict.get("resize_trigger"),
         "trace_events": len(trace),
         "trace_errors": trace_errors[:5],
     })
@@ -1865,6 +1868,13 @@ def collect_postmortem(dump_dir: str, expect_rank=None,
             total is not None and
             abs(total - measured_mttr_s) <= 0.10 * measured_mttr_s)
         ok = ok and rec["spans_sum_matches_mttr"]
+    if expect_resize_triggers is not None:
+        # The verdict must name every resize and its trigger, in
+        # order, from the typed elasticity events alone.
+        rec["named_resize_triggers"] = (
+            verdict.get("resize_triggers") ==
+            list(expect_resize_triggers))
+        ok = ok and rec["named_resize_triggers"]
     rec["ok"] = ok
     return rec
 
@@ -2275,6 +2285,549 @@ def run_mttr_matrix(ranks: int = 8, seed: int = 0,
                      "p90": _percentile(detects, 90),
                      "max": max(detects) if detects else None},
         "ok": all(c.get("ok") for c in cells),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# autoscale drill: grow -> migrate -> shrink, with latency numbers
+# ---------------------------------------------------------------------------
+
+_ASZ_DIM = 4
+
+
+def _asz_row_at(step: int, row: int, rows: int) -> np.ndarray:
+    """Closed-form float32 value of sparse-table row ``row`` after
+    ``step`` steps: the row's owner adds 0.5*(s+1) whenever
+    ``s % rows == row``, in step order — exactly one add per touch,
+    so the accumulation is bit-deterministic no matter which rank
+    owned the row at the time (ownership is ``j % world_size`` and
+    changes at every resize)."""
+    v = np.zeros((_ASZ_DIM,), np.float32)
+    for s in range(row, step, rows):
+        v += np.float32(0.5 * (s + 1))
+    return v
+
+
+def _asz_params_at(step: int, boundary: int, ranks_a: int,
+                   ranks_b: int, shape) -> np.ndarray:
+    """Dense-params closed form across a resize at ``boundary``:
+    steps below it ran at ``ranks_a``, the rest at ``ranks_b``."""
+    p = np.zeros(shape, np.float32)
+    for s in range(step):
+        p += np.float32(_mttr_step_total(
+            s, ranks_a if s < boundary else ranks_b))
+    return p
+
+
+def run_autoscale_drill(ranks: int = 8, grow_to: int = 16,
+                        seed: int = 0,
+                        steps_per_phase: int = 8,
+                        commit_every: int = 2,
+                        policy_window: int = 3,
+                        policy_cooldown_s: float = 2.0,
+                        migrate_after_s: float = 0.2,
+                        real_scorer: bool = False,
+                        delay_ms: float = 25.0,
+                        threshold: float = 4.0,
+                        min_lag_s: float = 0.004,
+                        post_steps: int = 6,
+                        hang_timeout_s: float = 20.0,
+                        commit_timeout_s: float = 3.0,
+                        budget_s: float = 60.0) -> dict:
+    """The closed elasticity loop end to end: grow, migrate, shrink —
+    driven by the REAL :class:`ElasticPolicy` under continuous traffic
+    with durable checkpoints (replicated dense params + rank-local
+    sparse row-shards whose ownership is redistributed at every
+    resize).
+
+    * **grow** (``ranks`` -> ``grow_to``): pending capacity is fed to
+      the policy every step; the hysteresis window must elapse before
+      the scale-up decision fires, then the world is rebuilt at
+      ``grow_to`` from the last durable checkpoint (bounded step loss,
+      bit-identical restore) and the replay fast path must re-engage;
+    * **migrate**: one rank is flagged slow — synthetically, or (with
+      ``real_scorer=True``) by the live straggler scorer under a
+      seeded ``runtime.submit=delay(...)`` failpoint — and after
+      ``migrate_after_s`` of continuous flagging the policy decides a
+      checkpoint-first eviction: the evict waits for a checkpoint
+      commit NEWER than the decision, and the post-decision tick must
+      land in the cooldown (refractory) window;
+    * **shrink** (``grow_to`` -> ``ranks``): the world is rebuilt at
+      the original size attributed to the migration, restored
+      bit-identical against the two-segment closed form, and replay
+      must re-engage again.
+
+    The drill-end postmortem must name BOTH resize triggers, in
+    order, from the typed flight-recorder events alone."""
+    import tempfile
+
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        LocalCommitCoordinator)
+    from horovod_tpu.common import metrics as _hm
+    from horovod_tpu.common import straggler as _sg
+    from horovod_tpu.runner.elastic.policy import (
+        ElasticPolicy, KIND_MIGRATE, KIND_SCALE_UP, Signals,
+        TRIGGER_MIGRATION, TRIGGER_SCALE_UP, note_resize,
+        observe_autoscale)
+
+    assert grow_to > ranks, (ranks, grow_to)
+    t0 = time.monotonic()
+    failpoints.reset()
+    bb_dir = _arm_blackbox()
+    ckpt_dir = tempfile.mkdtemp(prefix="hvd-autoscale-")
+    rng = random.Random("%d|autoscale" % seed)
+    victim = rng.randrange(1, grow_to)
+    shape = (193,)
+    rows = 3 * grow_to
+
+    saved_env = {}
+    env_overrides = {"HOROVOD_STRAGGLER_MIGRATE": "1"}
+    if real_scorer:
+        env_overrides["HOROVOD_STRAGGLER_THRESHOLD"] = repr(threshold)
+        env_overrides["HOROVOD_STRAGGLER_MIN_LAG"] = repr(min_lag_s)
+    for key, value in env_overrides.items():
+        saved_env[key] = os.environ.get(key)
+        os.environ[key] = value
+    if real_scorer:
+        _sg.reset()
+        _sg.configure(enabled=True)
+
+    resizes_c = _hm.REGISTRY.counter("hvd_elastic_resizes_total")
+    up0 = resizes_c.value(direction="up", trigger=TRIGGER_SCALE_UP)
+    down0 = resizes_c.value(direction="down",
+                            trigger=TRIGGER_MIGRATION)
+
+    policy = ElasticPolicy(min_np=ranks, max_np=grow_to,
+                           window=policy_window,
+                           cooldown_s=policy_cooldown_s,
+                           migrate_after_s=migrate_after_s)
+
+    record = {"kind": "autoscale_drill", "ranks": ranks,
+              "grow_to": grow_to, "seed": seed, "victim": victim,
+              "real_scorer": real_scorer,
+              "commit_every": commit_every,
+              "policy_window": policy_window,
+              "policy_cooldown_s": policy_cooldown_s,
+              "migrate_after_s": migrate_after_s}
+    hangs, errors, results_bad = [], [], []
+    state = {"params": np.zeros(shape, np.float32)}
+    table = {j: np.zeros((_ASZ_DIM,), np.float32)
+             for j in range(rows)}
+    world = world2 = world3 = None
+    all_mgrs = []
+
+    def step_world(w, nranks, step, name, op_index):
+        outs = {}
+
+        def one(rank):
+            try:
+                g = _mttr_grad(rank, step, shape)
+                outs[rank] = w.collective(rank, "allreduce", name, g,
+                                          op_index, hang_timeout_s)
+            except HangError as e:
+                hangs.append({"rank": rank, "step": step,
+                              "error": str(e)})
+            except Exception as e:
+                errors.append({"rank": rank, "step": step,
+                               "error": repr(e)[:300]})
+
+        ts = [threading.Thread(target=one, args=(r,), daemon=True,
+                               name="asz-r%d" % r)
+              for r in range(nranks)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=2 * hang_timeout_s)
+            if t.is_alive():
+                hangs.append({"rank": t.name, "step": step,
+                              "error": "step thread never exited"})
+        if len(outs) != nranks:
+            return None
+        expected = np.full(shape,
+                           np.float32(_mttr_step_total(step, nranks)),
+                           np.float32)
+        for r, out in outs.items():
+            if not np.allclose(out, expected, rtol=1e-5):
+                results_bad.append({"rank": r, "step": step})
+                return None
+        return outs[0]
+
+    def apply_step(out, step):
+        state["params"] = state["params"] + out
+        j = step % rows
+        table[j] = table[j] + np.float32(0.5 * (step + 1))
+
+    def save_all(mgrs, nranks, step):
+        # step = completed-step count; every rank saves the replicated
+        # dense state plus ITS slice of the sparse row-shard table
+        # (ownership j % nranks — the thing a resize redistributes).
+        for r in range(nranks):
+            mgrs[r].wait(2 * commit_timeout_s + 10)
+            local = {"emb/row/%03d" % j: table[j].copy()
+                     for j in range(rows) if j % nranks == r}
+            mgrs[r].save_async(step,
+                               {"obj/step": step,
+                                "tree/params": state["params"].copy()},
+                               local_items=local)
+
+    def restore_all():
+        mgr = CheckpointManager(ckpt_dir, rank=0, world_size=1)
+        try:
+            restored_step, items = mgr.restore_latest()
+        finally:
+            mgr.close(timeout=1.0)
+        return restored_step, items
+
+    def rows_match(items, restored_step):
+        return all(
+            np.array_equal(items.get("emb/row/%03d" % j),
+                           _asz_row_at(restored_step, j, rows))
+            for j in range(rows))
+
+    def reload_from(items):
+        state["params"] = np.array(items["tree/params"], np.float32)
+        for j in range(rows):
+            table[j] = np.array(items["emb/row/%03d" % j], np.float32)
+
+    try:
+        agg = 0.25 if real_scorer else 0.0
+        # --- phase A: traffic at `ranks`, pending capacity feeds the
+        # policy until the hysteresis window elapses -----------------
+        world = ChaosWorld(ranks, stall_shutdown_s=30.0,
+                           exchange_timeout_s=hang_timeout_s,
+                           metrics_agg_s=agg)
+        coordc = LocalCommitCoordinator()
+        mgrs = [CheckpointManager(ckpt_dir, rank=r, world_size=ranks,
+                                  coordinator=coordc, keep=3,
+                                  commit_timeout_s=commit_timeout_s)
+                for r in range(ranks)]
+        all_mgrs.extend(mgrs)
+        step = 0
+        t_pending0 = time.monotonic()
+        dec1 = t_dec1 = None
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline and not hangs and \
+                not errors and not results_bad:
+            t_s = time.monotonic()
+            out = step_world(world, ranks, step,
+                             "asz.a.%s" % "ab"[step % 2], step)
+            if out is None:
+                break
+            apply_step(out, step)
+            step += 1
+            cycle = time.monotonic() - t_s
+            if dec1 is None:
+                d = policy.observe(Signals(
+                    ranks, pending_hosts=grow_to - ranks,
+                    cycle_time_s=cycle))
+                if d is not None and d.kind == KIND_SCALE_UP:
+                    dec1, t_dec1 = d, time.monotonic()
+                    observe_autoscale("decision",
+                                      t_dec1 - t_pending0)
+                    if flight_recorder.ENABLED:
+                        flight_recorder.record(
+                            flight_recorder.ELASTIC_SCALE_UP,
+                            rank="driver",
+                            hosts="pending-%d" % (grow_to - ranks),
+                            slots=grow_to - ranks, epoch=1,
+                            trigger=d.trigger)
+            if step % commit_every == 0:
+                save_all(mgrs, ranks, step)
+                if dec1 is not None and step >= steps_per_phase:
+                    break
+        for m in mgrs:
+            m.wait(timeout=2 * commit_timeout_s + 10)
+        steps_a = step
+        committed_a = coordc.committed_step()
+        record.update({
+            "scale_up_decided": dec1 is not None,
+            "scale_up_reason": dec1.reason if dec1 else None,
+            "steps_a": steps_a, "committed_a": committed_a,
+        })
+        for m in mgrs:
+            m.close(timeout=1.0)
+        world.close()
+        world = None
+        if dec1 is None or hangs or errors or results_bad:
+            record.update({"ok": False, "hangs": hangs,
+                           "errors": errors,
+                           "results_bad": results_bad})
+            return record
+
+        # --- resize 1: grow to `grow_to` from the durable checkpoint
+        world2 = ChaosWorld(grow_to, stall_shutdown_s=30.0,
+                            exchange_timeout_s=hang_timeout_s,
+                            metrics_agg_s=agg)
+        restored_a, items = restore_all()
+        bit_a = bool(np.array_equal(
+            items["tree/params"],
+            _mttr_params_at(restored_a, ranks, shape)))
+        rows_a = rows_match(items, restored_a)
+        reload_from(items)
+        step = restored_a
+        t_admit1 = time.monotonic()
+        observe_autoscale("admission", t_admit1 - t_dec1)
+        note_resize("up", TRIGGER_SCALE_UP)
+        record.update({
+            "restored_a": restored_a,
+            "step_loss_a": steps_a - restored_a,
+            "bit_identical_a": bit_a, "rows_identical_a": rows_a,
+        })
+
+        # --- phase B: traffic at `grow_to`; a straggler ripens into a
+        # checkpoint-first migration -------------------------------
+        coordc2 = LocalCommitCoordinator()
+        mgrs2 = [CheckpointManager(ckpt_dir, rank=r,
+                                   world_size=grow_to,
+                                   coordinator=coordc2, keep=3,
+                                   commit_timeout_s=commit_timeout_s)
+                 for r in range(grow_to)]
+        all_mgrs.extend(mgrs2)
+        scorer = None
+        if real_scorer:
+            failpoints.configure(
+                "runtime.submit=delay(%gms,rank=%d)"
+                % (delay_ms, victim), seed=seed)
+            scorer = world2.runtimes[0].controller.server._straggler
+            assert scorer is not None, "scorer not armed"
+        first_step1_s = None
+        dec2 = t_dec2 = t_first_flag = None
+        ckpt_at_dec = None
+        t_evict = None
+        cooldown_checked = cooldown_ok = False
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline and not hangs and \
+                not errors and not results_bad:
+            t_s = time.monotonic()
+            out = step_world(world2, grow_to, step,
+                             "asz.b.%s" % "ab"[step % 2],
+                             10 ** 6 + step)
+            if out is None:
+                break
+            if first_step1_s is None:
+                first_step1_s = time.monotonic() - t_dec1
+                observe_autoscale("first_step", first_step1_s)
+            apply_step(out, step)
+            step += 1
+            cycle = time.monotonic() - t_s
+            if step % commit_every == 0:
+                save_all(mgrs2, grow_to, step)
+            if real_scorer:
+                scores = scorer.scores()
+                sig_scores = {r: scores.get(r, 0.0)
+                              for r in scorer.flagged()}
+            else:
+                sig_scores = {victim: 9.9}
+            if sig_scores and t_first_flag is None:
+                t_first_flag = time.monotonic()
+            if dec2 is None:
+                d = policy.observe(Signals(
+                    grow_to, straggler_scores=sig_scores,
+                    cycle_time_s=cycle))
+                if d is not None and d.kind == KIND_MIGRATE:
+                    dec2, t_dec2 = d, time.monotonic()
+                    ckpt_at_dec = coordc2.committed_step()
+                    observe_autoscale(
+                        "decision",
+                        t_dec2 - (t_first_flag or t_dec2))
+                    if flight_recorder.ENABLED:
+                        flight_recorder.record(
+                            flight_recorder.ELASTIC_MIGRATE,
+                            rank="driver", peer=d.rank,
+                            host="host-%d" % d.rank,
+                            phase="decided",
+                            score=round(sig_scores.get(d.rank, 0.0),
+                                        3))
+            elif not cooldown_checked:
+                # The tick right after a decision MUST land in the
+                # refractory window — the anti-flap contract.
+                cooldown_checked = True
+                cooldown_ok = policy.observe(Signals(
+                    grow_to, straggler_scores=sig_scores,
+                    cycle_time_s=cycle)) is None
+            if dec2 is not None and t_evict is None:
+                committed_now = coordc2.committed_step()
+                if committed_now is not None and \
+                        committed_now > (ckpt_at_dec or 0):
+                    # Checkpoint-then-evict: a commit NEWER than the
+                    # decision is durable — the straggler can go.
+                    t_evict = time.monotonic()
+                    observe_autoscale("admission", t_evict - t_dec2)
+                    note_resize("down", TRIGGER_MIGRATION)
+                    if flight_recorder.ENABLED:
+                        flight_recorder.record(
+                            flight_recorder.ELASTIC_MIGRATE,
+                            rank="driver", peer=dec2.rank,
+                            host="host-%d" % dec2.rank,
+                            phase="evict",
+                            ckpt_step=committed_now,
+                            ckpt_fresh=True)
+            if t_evict is not None and cooldown_checked and \
+                    step % commit_every == 0:
+                break
+        for m in mgrs2:
+            m.wait(timeout=2 * commit_timeout_s + 10)
+        steps_b = step
+        committed_b = coordc2.committed_step()
+        replay_grow = all(
+            rt.replay is not None and rt.replay.stats()["active"]
+            for rt in world2.runtimes)
+        if real_scorer:
+            record["victim_score"] = (scorer.scores() or {}).get(
+                victim, 0.0)
+        for m in mgrs2:
+            m.close(timeout=1.0)
+        world2.close()
+        world2 = None
+        failpoints.reset()
+        record.update({
+            "migrate_decided": dec2 is not None,
+            "migrate_rank": dec2.rank if dec2 else None,
+            "migrate_reason": dec2.reason if dec2 else None,
+            "evicted": t_evict is not None,
+            "cooldown_respected": cooldown_ok,
+            "steps_b": steps_b, "committed_b": committed_b,
+            "replay_reengaged_grow": replay_grow,
+        })
+        if dec2 is None or t_evict is None or hangs or errors or \
+                results_bad:
+            record.update({"ok": False, "hangs": hangs,
+                           "errors": errors,
+                           "results_bad": results_bad})
+            return record
+
+        # --- resize 2: shrink back to `ranks`, attributed to the
+        # migration ------------------------------------------------
+        world3 = ChaosWorld(ranks, stall_shutdown_s=30.0,
+                            exchange_timeout_s=hang_timeout_s,
+                            metrics_agg_s=agg)
+        restored_b, items2 = restore_all()
+        bit_b = bool(np.array_equal(
+            items2["tree/params"],
+            _asz_params_at(restored_b, restored_a, ranks, grow_to,
+                           shape)))
+        rows_b = rows_match(items2, restored_b)
+        reload_from(items2)
+        step = restored_b
+        first_step2_s = None
+        n_post = 0
+
+        def replay_active(w):
+            return all(
+                rt.replay is not None and rt.replay.stats()["active"]
+                for rt in w.runtimes)
+
+        # Step until the frozen schedule re-engages (at least
+        # ``post_steps`` steps, bounded — re-engagement after a resize
+        # is an acceptance criterion, not best-effort).
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not hangs and \
+                not errors and not results_bad:
+            out = step_world(world3, ranks, step,
+                             "asz.c.%s" % "ab"[step % 2],
+                             2 * 10 ** 6 + step)
+            if out is None:
+                break
+            if first_step2_s is None:
+                first_step2_s = time.monotonic() - t_evict
+                observe_autoscale("first_step", first_step2_s)
+            apply_step(out, step)
+            step += 1
+            n_post += 1
+            if n_post >= post_steps and replay_active(world3):
+                break
+        replay_shrink = replay_active(world3)
+
+        postmortem = collect_postmortem(
+            bb_dir, expect_resize_triggers=(TRIGGER_SCALE_UP,
+                                            TRIGGER_MIGRATION))
+        resizes_up = resizes_c.value(
+            direction="up", trigger=TRIGGER_SCALE_UP) - up0
+        resizes_down = resizes_c.value(
+            direction="down", trigger=TRIGGER_MIGRATION) - down0
+        record.update({
+            "restored_b": restored_b,
+            "step_loss_b": steps_b - restored_b,
+            "bit_identical_b": bit_b, "rows_identical_b": rows_b,
+            "replay_reengaged_shrink": replay_shrink,
+            "scale_up_s": {
+                "decision": round(t_dec1 - t_pending0, 3),
+                "admission": round(t_admit1 - t_dec1, 3),
+                "first_step": round(first_step1_s, 3)
+                if first_step1_s is not None else None,
+            },
+            "migrate_s": {
+                "decision": round(t_dec2 - (t_first_flag or t_dec2),
+                                  3),
+                "ckpt_wait": round(t_evict - t_dec2, 3),
+                "first_step": round(first_step2_s, 3)
+                if first_step2_s is not None else None,
+            },
+            "resizes_total": {"up": resizes_up, "down": resizes_down},
+            "postmortem": postmortem,
+            "hangs": hangs, "errors": errors,
+            "results_bad": results_bad,
+            "ok": (not hangs and not errors and not results_bad and
+                   bit_a and rows_a and bit_b and rows_b and
+                   (steps_a - restored_a) <= commit_every and
+                   (steps_b - restored_b) <= commit_every and
+                   (dec2.rank == victim) and cooldown_ok and
+                   first_step1_s is not None and
+                   first_step2_s is not None and
+                   replay_grow and replay_shrink and
+                   resizes_up >= 1 and resizes_down >= 1 and
+                   postmortem.get("ok", False)),
+        })
+        return record
+    finally:
+        for m in all_mgrs:
+            try:
+                m.close(timeout=1.0)
+            except Exception:
+                pass
+        for w in (world, world2, world3):
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+        failpoints.reset()
+        if real_scorer:
+            _sg.reset()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        flight_recorder.reset()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        shutil.rmtree(bb_dir, ignore_errors=True)
+        record["elapsed_s"] = round(time.monotonic() - t0, 3)
+
+
+def run_autoscale_matrix(ranks: int = 8, grow_to: int = 16,
+                         seed: int = 0) -> dict:
+    """Both migration signal sources over the full 8->16->8 resize
+    path: the synthetic flagged-score feed (deterministic timing) and
+    the live straggler scorer under a seeded delay failpoint."""
+    t0 = time.monotonic()
+    cells = {
+        "synthetic": run_autoscale_drill(ranks=ranks, grow_to=grow_to,
+                                         seed=seed),
+        "real_scorer": run_autoscale_drill(
+            ranks=ranks, grow_to=grow_to, seed=seed, real_scorer=True,
+            migrate_after_s=0.8, budget_s=90.0),
+    }
+    lats = [c["scale_up_s"]["first_step"] for c in cells.values()
+            if (c.get("scale_up_s") or {}).get("first_step")
+            is not None]
+    return {
+        "kind": "autoscale_matrix", "ranks": ranks,
+        "grow_to": grow_to, "seed": seed, "cells": cells,
+        "autoscale_s": {"p50": _percentile(lats, 50),
+                        "max": max(lats) if lats else None},
+        "ok": all(c.get("ok") for c in cells.values()),
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
 
@@ -2743,6 +3296,16 @@ def main(argv=None) -> int:
     parser.add_argument("--fanout", type=int, default=None,
                         help="relay arity (default: 2 for --relay, "
                              "8 for --relay-scale)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run the closed-loop elasticity drill "
+                             "matrix (grow 8->16 via policy scale-up, "
+                             "checkpoint-first straggler migration, "
+                             "shrink 16->8; synthetic + real-scorer "
+                             "signal sources) instead of the "
+                             "fault-schedule soak")
+    parser.add_argument("--grow-to", type=int, default=None,
+                        help="autoscale drill target size "
+                             "(default: 2 * --ranks)")
     parser.add_argument("--tune-drill", action="store_true",
                         help="run the autotune-then-freeze abort "
                              "drills (rank killed mid-search + "
@@ -2754,6 +3317,19 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING)
+    if args.autoscale:
+        report = run_autoscale_matrix(ranks=args.ranks,
+                                      grow_to=args.grow_to or
+                                      2 * args.ranks,
+                                      seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        summary = {k: report.get(k) for k in
+                   ("ranks", "grow_to", "autoscale_s", "ok",
+                    "elapsed_s")}
+        print("CHAOSJSON " + json.dumps(summary))
+        return 0 if report["ok"] else 1
     if args.tune_drill:
         report = {
             "kill": run_tune_kill_drill(mode="kill",
