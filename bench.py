@@ -1894,6 +1894,8 @@ def bench_straggler(args, smoke: bool) -> dict:
                 seed=rep, serve_status=(rep == 0)))
         ttas = [c["tta_s"] for c in cells
                 if c.get("tta_s") is not None]
+        ttrcs = [c["ttrc_s"] for c in cells
+                 if c.get("ttrc_s") is not None]
         out["cells"][mode] = {
             "reps": reps,
             "all_named": all(c.get("named") for c in cells),
@@ -1901,6 +1903,12 @@ def bench_straggler(args, smoke: bool) -> dict:
             "tta_p50_s": round(_percentile(ttas, 50), 3)
             if ttas else None,
             "tta_max_s": round(max(ttas), 3) if ttas else None,
+            # WHY latency: fault -> profile digest naming the injected
+            # delay site (advisory in the drill verdict, measured here).
+            "ttrc_p50_s": round(_percentile(ttrcs, 50), 3)
+            if ttrcs else None,
+            "root_cause_named": all(
+                c.get("root_cause_named") for c in cells),
             "victim_score_min": round(min(
                 c["victim_score"] for c in cells), 2),
             "hvdtop_rc": cells[0].get("hvdtop_rc"),
@@ -1960,6 +1968,31 @@ def check_straggler_regression(out: dict, repo_dir: str):
               "%.1f%% vs %s (%.3fs -> %.3fs), beyond the %.0f%% band"
               % (delta_pct, prior_source, prior_tta,
                  cur_tta, tol_pct), file=sys.stderr)
+    # Same contract for time-to-root-cause (the WHY latency): the
+    # digest rides the metrics frames, so a TTRC blowup usually means
+    # the publish->MR->recover path grew a stall, not the profiler.
+    prior_rc = _prior_bench_value(
+        repo_dir,
+        r'"straggler\\?":.*?"negotiation\\?":\s*\{[^{}]*?'
+        r'"ttrc_p50_s\\?":\s*([0-9.]+)')
+    cur_ttrc = (cells.get("negotiation") or {}).get("ttrc_p50_s")
+    if prior_rc is None or cur_ttrc is None:
+        return  # first round with root-cause timing
+    prior_ttrc, prior_rc_source = prior_rc
+    rc_delta_pct = (cur_ttrc - prior_ttrc) \
+        / max(prior_ttrc, 1e-9) * 100.0
+    cur["ttrc_vs_prior"] = {
+        "prior_ttrc_p50_s": prior_ttrc,
+        "prior_source": prior_rc_source,
+        "delta_pct": round(rc_delta_pct, 1),
+        "tolerance_pct": tol_pct,
+        "regressed": rc_delta_pct > tol_pct,
+    }
+    if cur["ttrc_vs_prior"]["regressed"]:
+        print("WARNING: straggler time-to-root-cause regressed "
+              "%.1f%% vs %s (%.3fs -> %.3fs), beyond the %.0f%% band"
+              % (rc_delta_pct, prior_rc_source, prior_ttrc,
+                 cur_ttrc, tol_pct), file=sys.stderr)
 
 
 def bench_dlrm(args, smoke: bool) -> dict:

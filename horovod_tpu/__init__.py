@@ -26,6 +26,7 @@ from .common.basics import (Adasum, Average, Max, Min, Product, Sum,
                             mpi_enabled,
                             mpi_threads_supported, nccl_built, num_chips,
                             rank, remove_process_set, shutdown, size,
+                            slo_status,
                             start_timeline, status, stop_timeline,
                             cuda_built,
                             rocm_built, ccl_built, tune_status,
@@ -53,7 +54,7 @@ __all__ = [
     "ccl_built", "xla_built", "xla_enabled",
     "start_timeline", "stop_timeline",
     "metrics_snapshot", "cluster_metrics_snapshot", "tune_status",
-    "status",
+    "status", "slo_status",
     "ProcessSet", "global_process_set", "add_process_set",
     "remove_process_set",
     # ops & op constants

@@ -269,6 +269,14 @@ def init(comm=None, process_sets=None):
         if flight_recorder.ENABLED:
             flight_recorder.install_signal_handler()
 
+        # Why-is-it-slow plane: rank-tag the sampling profiler and the
+        # SLO evaluator (both armed at import from HOROVOD_PROFILE /
+        # HOROVOD_SLO; set_rank is a no-op when disarmed).
+        from . import profiler as profiler_mod
+        from . import slo as slo_mod
+        profiler_mod.set_rank(state.rank_info.rank)
+        slo_mod.set_rank(state.rank_info.rank)
+
         from ..ops.backend import create_backend
         state.backend = create_backend(state)
 
@@ -296,7 +304,8 @@ def init(comm=None, process_sets=None):
                 state.metrics_server = metrics_mod.serve(
                     port=port,
                     cluster_provider=cluster_metrics_snapshot,
-                    status_provider=status)
+                    status_provider=status,
+                    profile_provider=profiler_mod.profile_dict)
                 logger.info("metrics endpoint on port %d",
                             state.metrics_server.port)
             except (OSError, OverflowError, ValueError):
@@ -537,6 +546,8 @@ def status() -> dict:
     and slow flags, and negotiation counters.  ``tools/hvdtop.py``
     renders this dict live."""
     from . import metrics as metrics_mod
+    from . import profiler as profiler_mod
+    from . import slo as slo_mod
     from . import straggler as straggler_mod
     state = _state()
     rt = state.runtime
@@ -545,6 +556,8 @@ def status() -> dict:
         "size": state.rank_info.size,
         "initialized": state.initialized,
         "straggler_armed": straggler_mod.ENABLED,
+        "profile_armed": profiler_mod.ENABLED,
+        "slo_armed": slo_mod.ENABLED,
     }
     snap = metrics_mod.snapshot()
     counters = snap.get("counters", {})
@@ -567,11 +580,26 @@ def status() -> dict:
     collector = getattr(rt, "phase_collector", None)
     if straggler_mod.ENABLED and collector is not None:
         out["phases"] = collector.local_phases()
+    if slo_mod.ENABLED:
+        out["slo"] = slo_mod.slo_status()
+    if profiler_mod.ENABLED:
+        prof = profiler_mod.instance()
+        if prof is not None:
+            out["hot_frames"] = prof.top_frames()
     server = getattr(getattr(rt, "controller", None), "server", None)
     cluster = getattr(server, "status", None)
     if cluster is not None:
         out["cluster"] = cluster()
     return out
+
+
+def slo_status() -> dict:
+    """The SLO plane's live view (``hvd.slo_status()``): targets,
+    short/long-window achieved SLIs, burn rates, and alert counts —
+    ``{"enabled": False}`` when ``HOROVOD_SLO`` is off.  Callable
+    before init (the plane arms at import)."""
+    from . import slo as slo_mod
+    return slo_mod.slo_status()
 
 
 def tune_status() -> Optional[dict]:

@@ -36,7 +36,9 @@ from . import env as env_mod
 from . import failpoints as _fp
 from . import flight_recorder as _fr
 from . import metrics
+from . import profiler as _prof
 from . import relay as relay_mod
+from . import slo as _slo
 from . import straggler as _sg
 from .controller import Controller, MessageTable, construct_response
 from .fusion import fuse_responses
@@ -1705,6 +1707,45 @@ class CoordinatorServer:
             return None
         return top
 
+    def profile_digests(self) -> Dict[int, List[dict]]:
+        """Per-rank top-K hot-frame digests recovered from the latest
+        MR/MA snapshots (common/profiler.py rank-labeled gauges) —
+        computed on demand from already-held state, cold paths only
+        (/status, stall warnings, drill verdicts).  Empty when no rank
+        runs with HOROVOD_PROFILE=1."""
+        with self._lock:
+            aggs = [a.get("snapshot") or {}
+                    for a in self._relay_metrics.values()]
+            snaps = list(self._rank_metrics.values())
+        out: Dict[int, List[dict]] = {}
+        for snap in aggs:        # relay aggregates first ...
+            out.update(_prof.digest_from_snapshot(snap))
+        for snap in snaps:       # ... direct MR replies overlay
+            out.update(_prof.digest_from_snapshot(snap))
+        return out
+
+    def profile_root_cause(self, rank: int) -> Optional[str]:
+        """One root-cause clause for ``rank`` ("failpoints:maybe_fail
+        (submit lane, 72% of samples)") from its digest, or None when
+        no digest has arrived — the stall inspector and the drill
+        verdict attach this to their warning text."""
+        text = _prof.describe_digest(self.profile_digests().get(rank))
+        return text or None
+
+    def slo_readings(self) -> Dict[int, dict]:
+        """Per-rank SLO SLI/burn readings recovered from the latest
+        MR/MA snapshots (common/slo.py rank-labeled gauges)."""
+        with self._lock:
+            aggs = [a.get("snapshot") or {}
+                    for a in self._relay_metrics.values()]
+            snaps = list(self._rank_metrics.values())
+        out: Dict[int, dict] = {}
+        for snap in aggs:
+            out.update(_slo.slo_from_snapshot(snap))
+        for snap in snaps:
+            out.update(_slo.slo_from_snapshot(snap))
+        return out
+
     def status(self) -> dict:
         """The /status plane's cluster view (JSON-ready): per-rank
         liveness + straggler state, negotiation counters, and queue
@@ -1754,6 +1795,21 @@ class CoordinatorServer:
                 if score is not None:
                     d["score"] = score
                     d["slow"] = int(r_s) in snap["flagged"]
+        digests = self.profile_digests()
+        if digests:
+            # Why-is-it-slow: per-rank digests (k-ordered) plus a
+            # one-line hot_frame on each rank row so hvdtop can show
+            # the dominant frame without a second request.
+            out["profile"] = {str(r): entries
+                              for r, entries in digests.items()}
+            for r_s, d in ranks.items():
+                entries = digests.get(int(r_s))
+                if entries:
+                    d["hot_frame"] = "%s [%s]" % (
+                        entries[0]["frame"], entries[0]["lane"])
+        slo_map = self.slo_readings()
+        if slo_map:
+            out["slo"] = {str(r): v for r, v in slo_map.items()}
         out["ranks"] = ranks
         return out
 
@@ -2432,6 +2488,14 @@ class CoordinatorServer:
                     sg_note = (" Missing ranks appear blocked behind "
                                "straggler rank %d (score %.1f): slow,"
                                " not dead." % top)
+                    # Root cause when the profiler digests carry one:
+                    # name the frame the implicated rank is stuck in
+                    # (common/profiler.py), turning "rank 3 is slow"
+                    # into "rank 3 is slow in shard_io:fsync".
+                    cause = self.profile_root_cause(top[0])
+                    if cause:
+                        sg_note += (" Rank %d dominant frame: %s."
+                                    % (top[0], cause))
                 elif top is not None:
                     sg_note = (" Top straggler rank %d (score %.1f) "
                                "is not among the missing ranks; "
@@ -2451,6 +2515,12 @@ class CoordinatorServer:
                                tensor=name, submitted=submitted,
                                missing=missing, age_s=round(age, 3),
                                straggler=list(top) if top else None)
+                if _prof.ENABLED:
+                    # Why-is-it-slow: freeze the profiler window at
+                    # the moment the coordinator surfaced the stall.
+                    _prof.trigger_capture(
+                        "stall", "tensor %s missing %s" % (
+                            name, missing))
                 if 0 < self._stall_shutdown_s <= age:
                     logger.error(
                         "stalled tensor %s exceeded shutdown threshold "
@@ -2793,6 +2863,12 @@ class NetworkController(Controller):
                     raise
                 logger.warning("native coordinator unavailable; using "
                                "the Python coordinator", exc_info=True)
+        if _slo.ENABLED:
+            # Rank 0 hosts the coordinator: its SLO burn alerts become
+            # the job-level KV notice the elastic driver folds into
+            # ElasticPolicy.Signals (None client → no hook, local
+            # alerting still works).
+            _slo.set_burn_hook(self._make_slo_publisher())
         return CoordinatorServer(
             self.size, port=port,
             fusion_threshold=state.knobs.fusion_threshold_bytes,
@@ -2886,6 +2962,42 @@ class NetworkController(Controller):
             # client's full HTTP timeout.
             threading.Thread(target=publish, args=(rank, score),
                              name="hvd-slow-publish", daemon=True
+                             ).start()
+
+        return hook
+
+    def _make_slo_publisher(self):
+        """Rank-0 hook: publish this job's SLO reading to the
+        rendezvous KV under ``elastic/slo`` whenever the plane
+        evaluates a burn alert — the load-trend signal
+        ``runner/elastic/driver.py`` folds into
+        ``ElasticPolicy.Signals`` (cycle_time_s / steps_per_s;
+        consumed read-only until the SLO-driven controller lands,
+        ROADMAP item 4).  One key, not per-rank: the SLIs are a
+        job-level reading taken on the coordinator."""
+        client = self._rendezvous_client()
+        if client is None:
+            return None
+
+        def publish(alert, _client=client):
+            reading = _slo.signals_reading()
+            try:
+                _client.put("elastic", "slo", json.dumps({
+                    "sli": alert.get("sli"),
+                    "burn_short": alert.get("burn_short"),
+                    "burn_long": alert.get("burn_long"),
+                    "steps_per_s": reading.get("steps_per_s"),
+                    "cycle_time_s": reading.get("cycle_time_s"),
+                    "wall": time.time(),
+                }).encode())
+            except OSError:
+                logger.warning("could not publish the SLO notice to "
+                               "the rendezvous KV", exc_info=True)
+
+        def hook(alert):
+            # Off the evaluator loop, same as the slow-rank publisher.
+            threading.Thread(target=publish, args=(alert,),
+                             name="hvd-slo-publish", daemon=True
                              ).start()
 
         return hook
@@ -3600,6 +3712,16 @@ class NetworkController(Controller):
             # own label) — zero new wire kinds, zero extra frames,
             # and attribution keeps working during replay.
             self._phase_collector.publish(self.rank)
+        if _prof.ENABLED:
+            # Same contract for the sampling profiler's top-K hot
+            # frame digest (common/profiler.py): rank-labeled gauges
+            # on the existing MR frame, so rank 0 can name the frame
+            # a slow rank is stuck in without any new wire kind.
+            _prof.publish_digest(self.rank)
+        if _slo.ENABLED:
+            # And the SLO plane's windowed SLIs + burn rates
+            # (common/slo.py).
+            _slo.publish(self.rank)
         try:
             payload = json.dumps(metrics.snapshot()).encode()
         except (TypeError, ValueError):

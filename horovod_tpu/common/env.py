@@ -217,6 +217,89 @@ def straggler_min_lag() -> float:
     """The attribution noise floor in seconds (see above)."""
     return max(1e-4, env_float(HOROVOD_STRAGGLER_MIN_LAG,
                                STRAGGLER_MIN_LAG_DEFAULT))
+
+
+# Continuous sampling profiler (common/profiler.py): a low-Hz
+# sys._current_frames walker per rank that attributes wall time to
+# subsystem lanes (submit/controller/ring/replay/checkpoint), ships a
+# top-K hot-frame digest on the MR metrics frames, serves the full
+# collapsed-stack profile at job-secret GET /profile, and snapshots
+# the last window when a straggler flag / stall warning / SLO burn
+# fires.  HOROVOD_PROFILE=1 arms it; disabled cost on hot paths is ONE
+# attribute check (the failpoints precedent, pinned by
+# tests/test_profiler.py).
+HOROVOD_PROFILE = "HOROVOD_PROFILE"
+# Sampling frequency in Hz.  10 Hz resolves anything that dominates a
+# multi-second window while staying ~0.1% overhead; drills bump it to
+# sharpen time-to-root-cause.
+HOROVOD_PROFILE_HZ = "HOROVOD_PROFILE_HZ"
+PROFILE_HZ_DEFAULT = 10.0
+# Digest width: how many hot frames each rank folds into its MR reply.
+HOROVOD_PROFILE_TOPK = "HOROVOD_PROFILE_TOPK"
+PROFILE_TOPK_DEFAULT = 5
+
+
+def profile_hz() -> float:
+    """Profiler sampling frequency, parsed freshly (drills sweep it
+    per phase); clamped to [0.1, 250] Hz."""
+    return min(250.0, max(0.1, env_float(HOROVOD_PROFILE_HZ,
+                                         PROFILE_HZ_DEFAULT)))
+
+
+def profile_topk() -> int:
+    """Hot-frame digest width (entries per rank per MR reply)."""
+    return max(1, env_int(HOROVOD_PROFILE_TOPK, PROFILE_TOPK_DEFAULT))
+
+
+# SLO plane (common/slo.py): steps/s and cycle-time SLIs over short /
+# long sliding windows with multi-window burn-rate alerting (the SRE
+# fast+slow window pattern: an alert fires only when BOTH windows burn
+# error budget faster than the threshold, killing both flap and
+# blindness).  HOROVOD_SLO=1 arms it; targets of 0 disable their SLI.
+HOROVOD_SLO = "HOROVOD_SLO"
+# Throughput target: completed collective ops per second (the
+# hvd_worker_op_rate vocabulary).  0 (default) = SLI off.
+HOROVOD_SLO_STEPS_PER_S = "HOROVOD_SLO_STEPS_PER_S"
+SLO_STEPS_PER_S_DEFAULT = 0.0
+# Latency target: controller cycle seconds (matches
+# hvd_controller_cycle_seconds).  0 (default) = SLI off.
+HOROVOD_SLO_CYCLE_SECONDS = "HOROVOD_SLO_CYCLE_SECONDS"
+SLO_CYCLE_SECONDS_DEFAULT = 0.0
+# Sliding-window lengths (seconds): short catches fast regressions,
+# long confirms they are sustained.
+HOROVOD_SLO_WINDOW_SHORT = "HOROVOD_SLO_WINDOW_SHORT"
+SLO_WINDOW_SHORT_DEFAULT = 30.0
+HOROVOD_SLO_WINDOW_LONG = "HOROVOD_SLO_WINDOW_LONG"
+SLO_WINDOW_LONG_DEFAULT = 300.0
+# Burn-rate alert threshold: alert when shortfall/budget >= this in
+# BOTH windows (2.0 = burning monthly budget at 2x sustainable rate).
+HOROVOD_SLO_BURN_THRESHOLD = "HOROVOD_SLO_BURN_THRESHOLD"
+SLO_BURN_THRESHOLD_DEFAULT = 2.0
+# Error budget: tolerated fractional shortfall against the target
+# (0.1 = achieving 90% of target consumes budget at exactly 1x).
+HOROVOD_SLO_BUDGET = "HOROVOD_SLO_BUDGET"
+SLO_BUDGET_DEFAULT = 0.1
+
+
+def slo_targets() -> dict:
+    """SLO targets + window/burn config, parsed freshly per
+    evaluation tick (drills sweep targets to force burns)."""
+    return {
+        "steps_per_s": max(0.0, env_float(HOROVOD_SLO_STEPS_PER_S,
+                                          SLO_STEPS_PER_S_DEFAULT)),
+        "cycle_seconds": max(0.0, env_float(
+            HOROVOD_SLO_CYCLE_SECONDS, SLO_CYCLE_SECONDS_DEFAULT)),
+        "window_short": max(1.0, env_float(HOROVOD_SLO_WINDOW_SHORT,
+                                           SLO_WINDOW_SHORT_DEFAULT)),
+        "window_long": max(1.0, env_float(HOROVOD_SLO_WINDOW_LONG,
+                                          SLO_WINDOW_LONG_DEFAULT)),
+        "burn_threshold": max(0.1, env_float(
+            HOROVOD_SLO_BURN_THRESHOLD, SLO_BURN_THRESHOLD_DEFAULT)),
+        "budget": min(1.0, max(1e-4, env_float(HOROVOD_SLO_BUDGET,
+                                               SLO_BUDGET_DEFAULT))),
+    }
+
+
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 # Opt-in Prometheus-text /metrics endpoint: set to a port (0 = pick an
 # ephemeral one); unset = no endpoint.  Each rank binds

@@ -114,6 +114,12 @@ STALL = "stall"              # tensor, missing — stall machinery fired
 STRAGGLER = "straggler"      # peer, score — rank crossed the slow
                              # threshold (common/straggler.py)
 SUBMIT = "submit"            # name, type — one eager collective
+# Why-is-it-slow plane (common/profiler.py, common/slo.py): triggered
+# profile captures carry the dominant frames at the moment a symptom
+# (straggler flag / stall / SLO burn) fired; SLO_BURN marks the
+# multi-window burn-rate crossing itself.
+PROFILE = "profile"          # rank?, reason, detail?, frames
+SLO_BURN = "slo_burn"        # sli, short, long, target — burn alert
 NOTE = "note"                # harness / drill markers (drill.fault ...)
 
 _VERSION = 1

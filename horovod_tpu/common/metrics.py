@@ -92,6 +92,17 @@ class _Metric:
         with self._lock:
             self._children.clear()
 
+    def drop(self, **labels):
+        """Retire every labeled child matching ALL given label values.
+        Publishers that re-emit a bounded top-K family (the profiler
+        digest) use this so stale label combinations don't outlive the
+        set they belonged to — a labeled child otherwise lives forever."""
+        match = set("%s=%s" % (k, _sanitize(labels[k])) for k in labels)
+        with self._lock:
+            for key in [k for k in self._children
+                        if match.issubset(k.split(","))]:
+                del self._children[key]
+
     def _collapse(self, d: dict):
         """Unlabeled metrics snapshot to a bare value; labeled ones to
         ``{label_key: value}``."""
@@ -449,7 +460,8 @@ class MetricsServer:
     def __init__(self, port: int = 0, registry: Optional[MetricsRegistry] = None,
                  cluster_provider: Optional[Callable[[], Optional[dict]]] = None,
                  secret: Optional[str] = None,
-                 status_provider: Optional[Callable[[], Optional[dict]]] = None):
+                 status_provider: Optional[Callable[[], Optional[dict]]] = None,
+                 profile_provider: Optional[Callable[[], Optional[dict]]] = None):
         from http.server import ThreadingHTTPServer
 
         from ..runner import job_secret
@@ -459,6 +471,7 @@ class MetricsServer:
         self._registry = registry if registry is not None else REGISTRY
         self._cluster_provider = cluster_provider
         self._status_provider = status_provider
+        self._profile_provider = profile_provider
         server_self = self
 
         class _MetricsHandler(KVStoreHandler):
@@ -500,6 +513,37 @@ class MetricsServer:
                         payload = provider()
                     except Exception:
                         logger.debug("status provider failed",
+                                     exc_info=True)
+                        payload = None
+                    body = json.dumps(
+                        payload if payload is not None else {}
+                    ).encode()
+                    self.send_response(OK)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/profile":
+                    # This rank's sampling-profiler payload
+                    # (common/profiler.py): flame-ready collapsed
+                    # stacks + lane/GIL/blocking shares + the last
+                    # triggered capture — behind the SAME job-secret
+                    # HMAC as /metrics (a live stack profile is a
+                    # code map, never an unauthenticated
+                    # sidechannel).  404 when no provider is wired
+                    # (bare registry servers).
+                    provider = server_self._profile_provider
+                    if provider is None:
+                        self.send_response(NOT_FOUND)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    try:
+                        payload = provider()
+                    except Exception:
+                        logger.debug("profile provider failed",
                                      exc_info=True)
                         payload = None
                     body = json.dumps(
@@ -571,7 +615,8 @@ class MetricsServer:
 
 def serve(port: int = 0, registry: Optional[MetricsRegistry] = None,
           cluster_provider=None, secret: Optional[str] = None,
-          status_provider=None) -> MetricsServer:
+          status_provider=None, profile_provider=None) -> MetricsServer:
     return MetricsServer(port=port, registry=registry,
                          cluster_provider=cluster_provider, secret=secret,
-                         status_provider=status_provider)
+                         status_provider=status_provider,
+                         profile_provider=profile_provider)

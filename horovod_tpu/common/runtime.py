@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 from . import failpoints as _fp
 from . import flight_recorder as _fr
 from . import metrics
+from . import slo as _slo
 from . import straggler as _sg
 from . import timeline as tl
 from .controller import LoopbackController
@@ -107,6 +108,13 @@ class BackgroundRuntime:
                           "straggler_top", None)
             if top is not None:
                 self.stall_inspector.set_straggler_provider(top)
+            # And WHY it is slow: the coordinator's per-rank profile
+            # digests (common/profiler.py) name the dominant frame of
+            # the implicated rank in the same warning line.
+            rc = getattr(getattr(self.controller, "server", None),
+                         "profile_root_cause", None)
+            if rc is not None:
+                self.stall_inspector.set_root_cause_provider(rc)
         self._shutdown = threading.Event()
         self._wake = threading.Event()
         # Direct dispatch: the controller's recv thread EXECUTES each
@@ -509,7 +517,15 @@ class BackgroundRuntime:
         for resp in responses:
             self._perform_operation(resp)
         if pending or responses:
-            _CYCLE_SECONDS.observe(time.perf_counter() - t0)
+            cycle_dt = time.perf_counter() - t0
+            _CYCLE_SECONDS.observe(cycle_dt)
+            if _slo.ENABLED:
+                # SLO cycle-time SLI (common/slo.py): O(1) append
+                # under the tracker's leaf lock, evaluated cold at
+                # ~1 Hz.  Disabled cost: this one attribute check.
+                tr = _slo.tracker()
+                if tr is not None:
+                    tr.note_cycle(cycle_dt)
 
     # ------------------------------------------------------------------
     # execution (PerformOperation analog)
@@ -629,5 +645,12 @@ class BackgroundRuntime:
             # on the cold MR-reply path, never here.
             self.phase_collector.note_exec(
                 time.perf_counter() - sg_t0)
+        if _slo.ENABLED:
+            # SLO throughput SLI: one fused response completes
+            # len(entries) collective ops.  Disabled cost: this one
+            # attribute check.
+            tr = _slo.tracker()
+            if tr is not None:
+                tr.note_op(len(entries))
         for e, result in zip(entries, results):
             e.callback(True, result)

@@ -13,6 +13,7 @@ from typing import Dict, List, Set, Tuple
 
 from . import flight_recorder as _fr
 from . import metrics
+from . import profiler as _prof
 
 logger = logging.getLogger("horovod_tpu.stall")
 
@@ -36,11 +37,31 @@ class StallInspector:
         # rank" is distinguishable from "a rank died / coordinator
         # wedged" without a postmortem.
         self._straggler_provider = None
+        # Optional why-is-it-slow hook (common/profiler.py): when the
+        # coordinator also holds per-rank profile digests, the warning
+        # names the implicated rank's dominant frame — root cause, not
+        # just attribution.
+        self._root_cause_provider = None
 
     def set_straggler_provider(self, fn):
         """``fn() -> Optional[(rank, score)]`` — wired by the runtime
         on the rank hosting the Python coordinator."""
         self._straggler_provider = fn
+
+    def set_root_cause_provider(self, fn):
+        """``fn(rank) -> Optional[str]`` — a one-clause root cause for
+        the given rank ("failpoints:maybe_fail (submit lane, 72% of
+        samples)"), from the coordinator's profile digests."""
+        self._root_cause_provider = fn
+
+    def _root_cause_note(self, rank: int) -> str:
+        if self._root_cause_provider is None:
+            return ""
+        try:
+            cause = self._root_cause_provider(rank)
+        except Exception:
+            return ""
+        return (", dominant frame: %s" % cause) if cause else ""
 
     def _straggler_note(self) -> str:
         if self._straggler_provider is None:
@@ -51,9 +72,10 @@ class StallInspector:
             return ""
         if top is None:
             return ""
-        return (". Current top straggler: rank %d (score %.1f) — if "
+        return (". Current top straggler: rank %d (score %.1f%s) — if "
                 "it is among the waiting ranks, they are slow, not "
-                "dead" % top)
+                "dead" % (top[0], top[1],
+                          self._root_cause_note(top[0])))
 
     def record_uncached_tensor(self, name: str, rank: int):
         now = time.monotonic()
@@ -98,6 +120,12 @@ class StallInspector:
             # ring), not just which ranks are waiting.
             recent = _fr.recent_for_tensors(invalidate) \
                 if _fr.ENABLED and invalidate else []
+            if _prof.ENABLED:
+                # Why-is-it-slow: freeze the profiler's last window at
+                # the moment the stall surfaced (triggered capture —
+                # throttled, cold warning path).
+                _prof.trigger_capture(
+                    "stall", stalled_msgs[0][:120])
             logger.warning(
                 "One or more tensors were submitted to be reduced/gathered "
                 "but some ranks have not yet submitted them. Stalled ops: %s%s%s",

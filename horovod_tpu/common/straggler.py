@@ -66,6 +66,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from . import env as _env
 from . import flight_recorder as _fr
 from . import metrics
+from . import profiler as _prof
 
 logger = logging.getLogger("horovod_tpu.straggler")
 
@@ -366,6 +367,13 @@ class StragglerScorer:
                 _fr.record(_fr.STRAGGLER, rank=0, role="coord",
                            peer=rank, score=round(score, 3),
                            threshold=self.threshold)
+            if _prof.ENABLED:
+                # Why-is-it-slow: snapshot the profiler's last window
+                # at the moment of the crossing (common/profiler.py
+                # triggered capture — throttled, cold path).
+                _prof.trigger_capture(
+                    "straggler",
+                    "rank %d score %.2f" % (rank, score))
             self._fire_slow_hook(rank, score)
         # Re-fire the hook (throttled) for ranks STILL flagged: the
         # slow-rank KV notice is a heartbeat, not an edge — consumers

@@ -66,6 +66,14 @@ KEY_LOST_RANK = "lost-%d"
 # LOST notice is dead, not slow; the death path owns it.
 KEY_SLOW_RANK = "slow-%d"
 SLOW_NOTICE_STALE_S = 10.0  # ~5x the scorer's republish heartbeat
+# Written by the rank-0 coordinator's SLO plane (controller_net
+# _make_slo_publisher) on burn-rate alert crossings: the job-level
+# load reading (achieved steps/s + cycle time over the short window)
+# the driver folds into ElasticPolicy.Signals — consumed read-only
+# until the SLO-driven resize controller lands (ROADMAP item 4).  One
+# key, not per-rank: the SLIs are a job-level reading.
+KEY_SLO = "slo"
+SLO_NOTICE_STALE_S = 60.0   # alerts re-fire every ~30s while burning
 # Driver-process metrics snapshot, readable through the (job-secret
 # guarded) rendezvous HTTP server at GET /metrics/driver — the driver
 # has no worker /metrics endpoint, so the KV store is its read path.
@@ -551,12 +559,46 @@ class ElasticDriver:
                 rank = int(notice["rank"])
                 score = float(notice.get("score", 0.0))
                 wall = float(notice.get("wall", 0.0))
-            except (ValueError, KeyError):
+            except (ValueError, KeyError, TypeError):
+                # TypeError: a JSON null (or list/dict) in a numeric
+                # field — float(None) — must not escape into the
+                # policy tick.
                 continue
             if time.time() - wall > SLOW_NOTICE_STALE_S:
                 continue  # stale heartbeat: the rank recovered
             active[rank] = score
         self._slow_active = active
+
+    def _poll_slo(self) -> Dict[str, Optional[float]]:
+        """The coordinator's last SLO notice, staleness-bounded like
+        the slow-rank heartbeats: a notice older than
+        SLO_NOTICE_STALE_S means the burn resolved (alerts re-fire
+        while it persists) and must not keep steering the policy."""
+        out: Dict[str, Optional[float]] = {"steps_per_s": None,
+                                           "cycle_time_s": None}
+        if self._rendezvous is None or self._rendezvous.kvstore is None:
+            return out
+        try:
+            raw = self._rendezvous.kvstore.get(ELASTIC_SCOPE, KEY_SLO)
+        except Exception:
+            return out
+        if not raw:
+            return out
+        try:
+            notice = json.loads(raw.decode())
+            wall = float(notice.get("wall", 0.0))
+        except (ValueError, AttributeError, TypeError):
+            # TypeError: '"wall": null' (or any non-numeric JSON
+            # value) — float(None) — must not escape into the
+            # policy tick.
+            return out
+        if time.time() - wall > SLO_NOTICE_STALE_S:
+            return out
+        for key in ("steps_per_s", "cycle_time_s"):
+            v = notice.get(key)
+            if isinstance(v, (int, float)):
+                out[key] = float(v)
+        return out
 
     def _read_kv_ckpt_latest(self) -> Optional[int]:
         """The newest committed checkpoint step per the coordination
@@ -582,9 +624,12 @@ class ElasticDriver:
             size = self._world_size
         pending = len(self._host_manager.pending_hosts()) \
             if env_mod.elastic_scale_up_enabled() else 0
+        slo = self._poll_slo()
         decision = self._policy.observe(Signals(
             size, pending_hosts=pending,
-            straggler_scores=dict(self._slow_active)))
+            straggler_scores=dict(self._slow_active),
+            cycle_time_s=slo["cycle_time_s"],
+            steps_per_s=slo["steps_per_s"]))
         if decision is None:
             return False
         if decision.kind == KIND_SCALE_UP:

@@ -13,6 +13,7 @@ import sys
 import time
 import urllib.error
 import urllib.request
+import warnings
 
 import numpy as np
 import pytest
@@ -383,6 +384,22 @@ def test_negotiation_mode_names_the_failpoint_delayed_rank():
     ranks = rec["status"]["cluster"]["ranks"]
     assert ranks["3"]["slow"] and ranks["3"]["state"] == "alive"
     assert any("SLOW" in line for line in rec["hvdtop_lines"])
+    # The profiler digest rides the same drill: the naming verdict
+    # should come with the *why* — the injected delay site itself.
+    # Root cause is ADVISORY in tier-1 (matching chaos_soak's verdict
+    # contract): the digest rides the next metrics frame, so on a
+    # loaded CI machine it can land after the naming verdict.  When
+    # it did land, it must name the injected delay site; when it
+    # didn't, warn instead of flaking — the slow matrix and the
+    # slow-marked drill in test_profiler.py assert it strictly.
+    if rec["root_cause"] is not None:
+        assert rec["root_cause_named"], rec.get("root_cause")
+        assert rec["ttrc_s"] is not None and rec["ttrc_s"] < 20.0
+    else:
+        warnings.warn("straggler drill: root-cause digest did not "
+                      "land before the drill deadline (advisory in "
+                      "tier-1; strict in the slow matrix)")
+    assert any("profile digest" in line for line in rec["hvdtop_lines"])
 
 
 @pytest.mark.chaos
@@ -416,3 +433,10 @@ def test_straggler_matrix_slow():
                     mode=mode, ranks=8, victim=victim, delay_ms=25.0,
                     seed=victim, fanout=fanout)
                 assert rec["ok"], (mode, fanout, victim, rec)
+                # The strict root-cause verdict lives here, off
+                # tier-1: the tier-1 smoke keeps it advisory so a
+                # loaded CI machine can't flake on digest timing.
+                assert rec["root_cause_named"], \
+                    (mode, fanout, victim, rec.get("root_cause"))
+                assert rec["ttrc_s"] is not None and \
+                    rec["ttrc_s"] < 20.0, (mode, fanout, victim, rec)

@@ -948,9 +948,17 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
     rank never forces a replay exit.
 
     ``serve_status=True`` additionally serves a /status endpoint from
-    the live world and renders it through ``tools/hvdtop.py --once``
-    (the e2e acceptance path)."""
+    the live world and renders it through ``tools/hvdtop.py --once
+    --profile`` (the e2e acceptance path).
+
+    The sampling profiler (common/profiler.py) is armed for the whole
+    drill: after the observatory NAMES the victim, the verdict also
+    asks the coordinator's profile digests WHY — the dominant frame
+    must be the injected delay site (``failpoints:maybe_fail``, where
+    the delay rule sleeps), and ``ttrc_s`` records the fault→root-
+    cause latency the bench lane tracks as a p50."""
     from horovod_tpu.common import metrics as _hm
+    from horovod_tpu.common import profiler as _prof
     from horovod_tpu.common import straggler as _sg
 
     t_start = time.monotonic()
@@ -958,6 +966,7 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
     replay_mode = mode == "replay"
     failpoints.reset()
     _sg.reset()
+    _prof.reset()
     saved_env = {}
     for key, value in (("HOROVOD_STRAGGLER_THRESHOLD",
                         repr(threshold)),
@@ -965,6 +974,11 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
         saved_env[key] = os.environ.get(key)
         os.environ[key] = value
     _sg.configure(enabled=True)
+    # High-Hz for the drill: the victim sleeps delay_ms per submit, so
+    # at 50 Hz a handful of steps already dominate the digest (the
+    # production default 10 Hz is tuned for always-on overhead, not
+    # drill time-to-root-cause).
+    _prof.configure(enabled=True, hz=50.0, topk=5)
     failpoints.configure("runtime.submit=delay(%gms,rank=%d)"
                          % (delay_ms, victim), seed=seed)
     cycles_c = _hm.REGISTRY.counter("hvd_steady_state_cycles_replayed")
@@ -1056,6 +1070,24 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
                 named_at = time.monotonic()
                 cycles_at_named = cycles_c.value() - cycles0
                 break
+        # WHO is slow is named; now ask the profile digests WHY.  The
+        # digests ride the MR replies the coordinator already polls —
+        # nudge a poll and wait for the victim's digest to land (the
+        # drill world is one process, so the dominant active frame IS
+        # the victim's injected sleep: only it spends wall time in
+        # failpoints.maybe_fail).
+        root_cause = None
+        ttrc_s = None
+        if named_at is not None:
+            rc_deadline = time.monotonic() + 6.0
+            while time.monotonic() < rc_deadline:
+                cause = coord.profile_root_cause(victim)
+                if cause:
+                    root_cause = cause
+                    ttrc_s = time.monotonic() - t_armed
+                    break
+                coord.request_metrics()
+                time.sleep(0.15)
         # Let replay keep running a moment to prove the slow rank
         # never forces an exit while scores stay current.
         post_cycles = None
@@ -1119,7 +1151,7 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
             buf = io.StringIO()
             with contextlib.redirect_stdout(buf):
                 hvdtop_rc = hvdtop.main(
-                    ["--once",
+                    ["--once", "--profile",
                      "--url", "http://127.0.0.1:%d" % status_srv.port])
             hvdtop_out = buf.getvalue()
     finally:
@@ -1132,6 +1164,7 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
             world.close()
         failpoints.reset()
         _sg.reset()
+        _prof.reset()
         for key, value in saved_env.items():
             if value is None:
                 os.environ.pop(key, None)
@@ -1161,6 +1194,13 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
         "scores": {str(r): round(s, 3)
                    for r, s in sorted(final_scores.items())},
         "hangs": hangs, "errors": errors,
+        # Root cause stays advisory (not folded into ok): the digest
+        # rides the next metrics frame, so on a loaded CI machine it
+        # can land after the naming verdict without the drill lying.
+        "root_cause": root_cause,
+        "root_cause_named": bool(root_cause
+                                 and "maybe_fail" in root_cause),
+        "ttrc_s": round(ttrc_s, 3) if ttrc_s is not None else None,
         "ok": ok,
         "elapsed_s": round(time.monotonic() - t_start, 3),
     }
@@ -1173,7 +1213,7 @@ def run_straggler_drill(mode: str = "negotiation", ranks: int = 8,
         }
     if serve_status:
         out["hvdtop_rc"] = hvdtop_rc
-        out["hvdtop_lines"] = hvdtop_out.splitlines()[:16]
+        out["hvdtop_lines"] = hvdtop_out.splitlines()[:40]
         out["status"] = status_json
     return out
 

@@ -62,7 +62,32 @@ def _bar(score: float, threshold: float, width: int = 12) -> str:
     return "#" * filled + "." * (width - filled)
 
 
-def render(status: dict, now: float = None) -> str:
+def _profile_pane(cluster: dict) -> list:
+    """The --profile pane: the cluster-wide top-K hot-frame digest
+    (common/profiler.py rank-labeled gauges recovered from the MR/MA
+    frames), worst share first."""
+    profile = cluster.get("profile") or {}
+    lines = ["profile digest (per-rank top hot frames, share of "
+             "active samples):"]
+    if not profile:
+        lines.append("  (no digests: run ranks with HOROVOD_PROFILE=1)")
+        return lines
+    rows = []
+    for r_s, entries in profile.items():
+        for e in entries or []:
+            rows.append((float(e.get("share") or 0.0), int(r_s),
+                         e.get("lane", "?"), e.get("frame", "?")))
+    rows.sort(key=lambda t: (-t[0], t[1]))
+    lines.append("  %5s %4s  %-10s  %s" % ("share", "rank", "lane",
+                                           "frame"))
+    for share, rank, lane, frame in rows[:20]:
+        lines.append("  %4.0f%% %4d  %-10s  %s" % (share * 100, rank,
+                                                   lane, frame))
+    return lines
+
+
+def render(status: dict, now: float = None,
+           show_profile: bool = False) -> str:
     """One plain-text frame of the dashboard (shared by --once, the
     plain poller, and the curses screen)."""
     now = time.time() if now is None else now
@@ -101,8 +126,9 @@ def render(status: dict, now: float = None) -> str:
                      ", BROKEN" if cluster.get("broken") else "",
                      cluster.get("pending_tensors"),
                      threshold or "off"))
-    lines.append("%4s  %-7s %7s  %-12s %10s  %s" % (
-        "rank", "state", "score", "meter", "heard(s)", "flags"))
+    lines.append("%4s  %-7s %7s  %-12s %10s  %-30s %s" % (
+        "rank", "state", "score", "meter", "heard(s)", "hot frame",
+        "flags"))
     ranks = cluster.get("ranks") or {}
     order = sorted(ranks.items(),
                    key=lambda kv: (_STATE_ORDER.get(
@@ -116,15 +142,19 @@ def render(status: dict, now: float = None) -> str:
         if d.get("via_relay") is not None:
             flags.append("via relay %s" % d["via_relay"])
         heard = d.get("last_heard_age_s")
-        lines.append("%4s  %-7s %7.2f  %-12s %10s  %s" % (
+        lines.append("%4s  %-7s %7.2f  %-12s %10s  %-30s %s" % (
             r_s, d.get("state", "?"), score,
             _bar(score, threshold) if threshold else "",
             "%.2f" % heard if heard is not None else "-",
+            (d.get("hot_frame") or "-")[:30],
             " ".join(flags)))
     flagged = sg.get("flagged") or []
     if flagged:
         lines.append("slow ranks: %s (elastic/slow/<rank> published "
                      "to the rendezvous KV)" % flagged)
+    if show_profile:
+        lines.append("")
+        lines.extend(_profile_pane(cluster))
     return "\n".join(lines) + "\n"
 
 
@@ -136,7 +166,7 @@ def _poll_plain(args) -> int:
             print("hvdtop: could not fetch %s: %s" % (args.url, e),
                   file=sys.stderr)
             return 2
-        sys.stdout.write(render(status))
+        sys.stdout.write(render(status, show_profile=args.profile))
         sys.stdout.flush()
         if args.once:
             return 0
@@ -154,7 +184,7 @@ def _poll_curses(args) -> int:
             try:
                 status = fetch_status(args.url, args.secret,
                                       args.timeout)
-                frame = render(status)
+                frame = render(status, show_profile=args.profile)
             except (OSError, urllib.error.URLError, ValueError) as e:
                 frame = "hvdtop: could not fetch %s: %s\n" % (
                     args.url, e)
@@ -190,6 +220,9 @@ def main(argv=None) -> int:
                    help="per-fetch HTTP timeout, seconds")
     p.add_argument("--once", action="store_true",
                    help="print one plain-text frame and exit 0")
+    p.add_argument("--profile", action="store_true",
+                   help="append the cluster top-K hot-frame digest "
+                        "pane (ranks running HOROVOD_PROFILE=1)")
     p.add_argument("--plain", action="store_true",
                    help="poll in plain text (no curses)")
     args = p.parse_args(argv)
