@@ -1261,15 +1261,42 @@ for _ in range(3):
 chunks.sort(key=lambda c: c["steps_per_sec"])
 mid = chunks[len(chunks) // 2]
 
-# --- differential checkpoint cost, rank 0 (single-rank manager:
-# static runs have no rendezvous KV for the cross-process arbiter;
-# the per-shard byte ratio is what the lane gates on).
+# --- differential checkpoint cost under the REAL multi-rank commit
+# protocol (ROADMAP 3c): every worker rank writes its own shard and
+# marks prepare through the rendezvous-KV commit coordinator; rank 0
+# arbitrates the marks and publishes the manifest — no single-rank
+# stand-in.  Bytes come from the committed manifests themselves (the
+# sum of every rank's shard nbytes), so the ratio covers the whole
+# world's shards.
 ckpt = None
-if RANK == 0:
-    import shutil, tempfile
-    from horovod_tpu.checkpoint import CheckpointManager
-    cdir = tempfile.mkdtemp(prefix="hvd-dlrm-ckpt-")
-    mgr = CheckpointManager(cdir, rank=0, world_size=1, keep=4)
+mgr = coord = None
+KV = os.environ.get("BENCH_DLRM_KV")
+CDIR = os.environ.get("BENCH_DLRM_CKPT_DIR")
+if KV and CDIR:
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        KVCommitCoordinator, RowDelta,
+                                        read_manifest, step_dir)
+    from horovod_tpu.runner.http_server import RendezvousClient
+    host, port = KV.rsplit(":", 1)
+    coord = KVCommitCoordinator(RendezvousClient(host, int(port),
+                                                 timeout=30.0))
+    mgr = CheckpointManager(CDIR, rank=RANK, world_size=SIZE,
+                            coordinator=coord, keep=4)
+
+    def _wait_committed(step, deadline=120.0):
+        # save() returns at "prepared" on non-arbiter ranks; the next
+        # delta_plan() must see the committed manifest, so every rank
+        # waits for the arbiter's publish before moving on.
+        t0 = time.perf_counter()
+        while (coord.committed_step() or -1) < step:
+            if time.perf_counter() - t0 > deadline:
+                raise RuntimeError("step %d commit not visible" % step)
+            time.sleep(0.02)
+
+    def _step_bytes(step):
+        man = read_manifest(step_dir(CDIR, step))
+        return sum(int(e.get("nbytes", 0)) for e in man.shards)
+
     dense_np = {"dense/p%d" % i: np.asarray(l) for i, l in
                 enumerate(jax.tree_util.tree_leaves(params))}
     local = {}
@@ -1279,37 +1306,33 @@ if RANK == 0:
     t0 = time.perf_counter()
     mgr.save(1, dense_np, local_items=local)
     full_ms = (time.perf_counter() - t0) * 1e3
-    full_bytes = sum(
-        os.path.getsize(os.path.join(r, f))
-        for r, _, fs in os.walk(cdir) for f in fs)
+    _wait_committed(1)
+    full_bytes = _step_bytes(1)
 # CADENCE more steps on every rank (collective), then the delta.
 for _ in range(CADENCE):
     losses.append(one_step(sidx))
     sidx += 1
-if RANK == 0:
-    try:
-        touched = sum(t.touched_count() for t in tables)
-        local = {}
-        for t in tables:
-            local.update(t.durable_items(full=False))
-        t0 = time.perf_counter()
-        mgr.save(2, dense_np, local_items=local,
-                 delta_of=mgr.delta_plan())
-        delta_ms = (time.perf_counter() - t0) * 1e3
-        total_bytes = sum(
-            os.path.getsize(os.path.join(r, f))
-            for r, _, fs in os.walk(cdir) for f in fs)
-        delta_bytes = total_bytes - full_bytes
-        # Round-trip check: base+delta must replay to exactly this
-        # rank's live shard (full-table assembly needs every rank's
-        # shard, which a static run's single-rank manager lacks).
-        step, items = mgr.restore_latest()
-        from horovod_tpu.checkpoint import RowDelta
-        ok = all(
-            items[t.item_name()] == RowDelta(t.local_ids, t.local,
-                                             t.num_rows)
-            for t in tables)
-        mgr.close()
+if mgr is not None:
+    touched = sum(t.touched_count() for t in tables)
+    local = {}
+    for t in tables:
+        local.update(t.durable_items(full=False))
+    plan = mgr.delta_plan()
+    t0 = time.perf_counter()
+    mgr.save(2, dense_np, local_items=local, delta_of=plan)
+    delta_ms = (time.perf_counter() - t0) * 1e3
+    _wait_committed(2)
+    delta_bytes = _step_bytes(2)
+    # Round-trip check on EVERY rank: base+delta must replay to
+    # exactly this rank's live shard.
+    step, items = mgr.restore_latest()
+    ok = all(
+        items[t.item_name()] == RowDelta(t.local_ids, t.local,
+                                         t.num_rows)
+        for t in tables)
+    assert ok, "rank %d: delta roundtrip mismatch" % RANK
+    mgr.close()
+    if RANK == 0:
         ckpt = {
             "full_save_ms": round(full_ms, 2),
             "delta_save_ms": round(delta_ms, 2),
@@ -1321,10 +1344,11 @@ if RANK == 0:
             "table_rows_per_rank":
                 sum(len(t.local_ids) for t in tables),
             "cadence_steps": CADENCE,
+            "delta_of": plan,
+            "world_size_commits": SIZE,
+            "coordinator": "kv",
             "roundtrip_bit_identical": bool(ok),
         }
-    finally:
-        shutil.rmtree(cdir, ignore_errors=True)
 
 snap = hvd.metrics_snapshot()
 if RANK == 0:
@@ -1347,6 +1371,130 @@ if RANK == 0:
         "steady_state_exits":
             counters.get("hvd_steady_state_exits"),
         "metrics": snap,
+    }))
+hvd.shutdown()
+"""
+
+
+# Serving-plane trainer worker (docs/serving.md): the DLRM-tiny loop
+# with PERIODIC multi-rank KV commits — every CADENCE steps the world
+# persists a differential checkpoint through the real commit protocol,
+# feeding the manifest stream the parent's ServingReplica tails while
+# this loop keeps training.  The parent drives Zipf queries against
+# the replica concurrently; this worker only reports the commit
+# timeline (step + wall time per commit) so freshness lag can be
+# attributed against the trainer's own clock.
+_SERVE_TRAINER_SRC = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.models import (DLRMDense, bce_logits_loss,
+                                dlrm_tiny_config,
+                                synthetic_click_batch)
+from horovod_tpu.sparse import EmbeddingBag, ShardedEmbedding
+from horovod_tpu.checkpoint import (CheckpointManager,
+                                    KVCommitCoordinator)
+from horovod_tpu.runner.http_server import RendezvousClient
+
+hvd.init()
+RANK, SIZE = hvd.rank(), hvd.size()
+BATCH = int(os.environ.get("BENCH_SERVE_BATCH", "32"))
+STEPS = int(os.environ.get("BENCH_SERVE_TRAIN_STEPS", "30"))
+CADENCE = int(os.environ.get("BENCH_SERVE_CKPT_EVERY", "3"))
+LR = 0.05
+
+cfg = dlrm_tiny_config()
+tables = [ShardedEmbedding("dlrm.t%d" % i, rows, cfg.embed_dim,
+                           seed=7 + i)
+          for i, rows in enumerate(cfg.table_rows)]
+bags = [EmbeddingBag(t, mode="mean") for t in tables]
+
+model = DLRMDense(cfg)
+rng0 = jax.random.PRNGKey(0)
+dense0 = np.zeros((BATCH, cfg.num_dense), np.float32)
+emb0 = np.zeros((BATCH, cfg.num_tables * cfg.embed_dim), np.float32)
+params = jax.jit(lambda r, d, e: model.init(r, d, e))(
+    rng0, dense0, emb0)
+
+
+def loss_fn(params, dense_x, emb_in, labels):
+    return bce_logits_loss(model.apply(params, dense_x, emb_in),
+                           labels)
+
+
+grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 2)))
+
+
+def one_step(step_idx):
+    global params
+    rng = np.random.default_rng([RANK, step_idx])
+    dense_x, ids, offsets, labels = synthetic_click_batch(
+        rng, BATCH, cfg)
+    embs = [bag.forward(ids[i], offsets)
+            for i, bag in enumerate(bags)]
+    emb_in = np.concatenate(embs, axis=1)
+    loss, (gparams, gemb) = grad_fn(params, dense_x, emb_in, labels)
+    flat, tree = jax.flatten_util.ravel_pytree(gparams)
+    flat = np.asarray(hvd.allreduce(np.asarray(flat), op=hvd.Average,
+                                    name="dlrm.densegrad"))
+    gparams = tree(jax.numpy.asarray(flat))
+    params = jax.tree_util.tree_map(lambda p, g: p - LR * g,
+                                    params, gparams)
+    gemb = np.asarray(gemb)
+    for i, bag in enumerate(bags):
+        bag.backward(gemb[:, i * cfg.embed_dim:
+                          (i + 1) * cfg.embed_dim], lr=LR)
+    return float(loss)
+
+
+import jax.flatten_util  # noqa: E402
+
+host, port = os.environ["BENCH_SERVE_KV"].rsplit(":", 1)
+coord = KVCommitCoordinator(RendezvousClient(host, int(port),
+                                             timeout=30.0))
+# keep=None: the parent verifies served rows against committed steps
+# AFTER the run — GC must not collect them out from under the gate.
+mgr = CheckpointManager(os.environ["BENCH_SERVE_CKPT_DIR"], rank=RANK,
+                        world_size=SIZE, coordinator=coord, keep=None)
+
+
+def wait_committed(step, deadline=120.0):
+    t0 = time.perf_counter()
+    while (coord.committed_step() or -1) < step:
+        if time.perf_counter() - t0 > deadline:
+            raise RuntimeError("step %d commit not visible" % step)
+        time.sleep(0.02)
+
+
+commits, save_ms = [], []
+for step in range(1, STEPS + 1):
+    one_step(step)
+    if step % CADENCE == 0:
+        plan = mgr.delta_plan()
+        local, snaps = {}, []
+        for t in tables:
+            snap = t.snapshot_touched()
+            local.update(t.durable_items(full=plan is None))
+            snaps.append((t, snap))
+        dense_np = {"dense/p%d" % i: np.asarray(l) for i, l in
+                    enumerate(jax.tree_util.tree_leaves(params))}
+        t0 = time.perf_counter()
+        mgr.save(step, dense_np, local_items=local, delta_of=plan)
+        save_ms.append((time.perf_counter() - t0) * 1e3)
+        wait_committed(step)
+        for t, snap in snaps:
+            t.clear_touched(None if plan is None else snap)
+        commits.append({"step": step, "t": round(time.time(), 3),
+                        "kind": "base" if plan is None else "delta"})
+mgr.close()
+if RANK == 0:
+    print("BENCHJSON " + json.dumps({
+        "nproc": SIZE, "batch_per_rank": BATCH,
+        "train_steps": STEPS, "commit_cadence": CADENCE,
+        "commits": commits,
+        "save_ms_mean": round(sum(save_ms) / max(len(save_ms), 1), 2),
     }))
 hvd.shutdown()
 """
@@ -1472,11 +1620,10 @@ def _tune_env(profile_path=None, max_samples=None):
     return env
 
 
-def _run_benchjson_workers(src: str, nproc: int, extra_env=None,
-                           timeout=900) -> dict:
-    """Spawn ``nproc`` env-contract CPU worker processes running
-    ``src`` and parse rank 0's BENCHJSON line — the shared scaffolding
-    of every multi-process lane (tune, dlrm)."""
+def _spawn_benchjson_workers(src: str, nproc: int, extra_env=None):
+    """Launch ``nproc`` env-contract CPU worker processes running
+    ``src`` WITHOUT waiting — the serve lane queries a live replica
+    while its trainers run, so spawn and drain are separate steps."""
     repo = os.path.dirname(os.path.abspath(__file__))
     coord_port, ctrl_port = _free_ports(2)
     procs = []
@@ -1486,9 +1633,9 @@ def _run_benchjson_workers(src: str, nproc: int, extra_env=None,
             "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(nproc),
             "HOROVOD_LOCAL_RANK": str(rank),
             "HOROVOD_LOCAL_SIZE": str(nproc),
-            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
             "HOROVOD_TPU_COORDINATOR": "127.0.0.1:%d" % coord_port,
             "HOROVOD_CONTROLLER_ADDR": "127.0.0.1:%d" % ctrl_port,
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
             "HOROVOD_TPU_FORCE_CPU": "1",
             "PYTHONPATH": repo,
         })
@@ -1497,6 +1644,11 @@ def _run_benchjson_workers(src: str, nproc: int, extra_env=None,
         procs.append(subprocess.Popen(
             [sys.executable, "-c", src], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    return procs
+
+
+def _drain_benchjson_workers(procs, timeout=900) -> dict:
+    """Wait for spawned workers and parse rank 0's BENCHJSON line."""
     outs = []
     for p in procs:
         try:
@@ -1512,6 +1664,16 @@ def _run_benchjson_workers(src: str, nproc: int, extra_env=None,
         if line.startswith("BENCHJSON "):
             return json.loads(line[len("BENCHJSON "):])
     return {"error": "no result line: %s" % outs[0][-800:]}
+
+
+def _run_benchjson_workers(src: str, nproc: int, extra_env=None,
+                           timeout=900) -> dict:
+    """Spawn ``nproc`` env-contract CPU worker processes running
+    ``src`` and parse rank 0's BENCHJSON line — the shared scaffolding
+    of every multi-process lane (tune, dlrm, serve)."""
+    return _drain_benchjson_workers(
+        _spawn_benchjson_workers(src, nproc, extra_env=extra_env),
+        timeout=timeout)
 
 
 def _run_tune_workers(nproc: int, extra_env=None, timeout=600):
@@ -2055,10 +2217,29 @@ def bench_dlrm(args, smoke: bool) -> dict:
 
 
 def _run_dlrm_workers(nproc: int, smoke: bool, extra_env=None) -> dict:
+    import shutil
+    import tempfile
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+
     env = {"BENCH_DLRM_STEPS": "9" if smoke else "24"}
     env.update(extra_env or {})
-    return _run_benchjson_workers(_DLRM_WORKER_SRC, nproc,
-                                  extra_env=env, timeout=900)
+    # Real multi-rank commit plane for the checkpoint section: one
+    # rendezvous KV server in the parent carries the prepare marks and
+    # the arbiter's commit record; the workers share one checkpoint
+    # directory so rank 0 can gather every rank's shard into the
+    # manifest it publishes.
+    kv = RendezvousServer(verbose=0)
+    kv_port = kv.start()
+    cdir = tempfile.mkdtemp(prefix="hvd-dlrm-ckpt-")
+    env.setdefault("BENCH_DLRM_KV", "127.0.0.1:%d" % kv_port)
+    env.setdefault("BENCH_DLRM_CKPT_DIR", cdir)
+    try:
+        return _run_benchjson_workers(_DLRM_WORKER_SRC, nproc,
+                                      extra_env=env, timeout=900)
+    finally:
+        kv.stop()
+        shutil.rmtree(cdir, ignore_errors=True)
 
 
 def _load_prior_dlrm(repo_dir: str):
@@ -2129,6 +2310,200 @@ def check_dlrm_regression(out: dict, repo_dir: str):
               "0.1 differential-checkpoint target at the DLRM-tiny "
               "touch rate" % ratio, file=sys.stderr)
     out["dlrm_vs_prior"] = cmp
+
+
+def bench_serve(args, smoke: bool) -> dict:
+    """The online-serving lane (docs/serving.md): 8 DLRM worker ranks
+    train and commit differential checkpoints every few steps through
+    the real KV commit protocol while a :class:`ServingReplica` in
+    THIS process tails the manifest stream and answers a Zipf query
+    load at a target QPS.  Reports read p50/p99, freshness lag
+    p50/p99 (steps and seconds), achieved QPS, and the
+    bit-consistency gate: a sample of served (step, ids, rows)
+    triples is re-read from the committed chain after the run — every
+    served row must equal the committed table at the served step."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu.checkpoint import assemble_table
+    from horovod_tpu.common import metrics as _hm
+    from horovod_tpu.models import dlrm_tiny_config
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.serve import ServingReplica
+
+    nproc = int(os.environ.get("HOROVOD_BENCH_SERVE_RANKS", "8"))
+    qps = float(os.environ.get("HOROVOD_BENCH_SERVE_QPS", "50"))
+    env = {"BENCH_SERVE_TRAIN_STEPS": "12" if smoke else "36",
+           "BENCH_SERVE_CKPT_EVERY": "3",
+           # Tail aggressively: the lane measures freshness lag, not
+           # poll-interval quantisation.
+           "HOROVOD_SERVE_POLL_SECONDS": "0.05"}
+    os.environ["HOROVOD_SERVE_POLL_SECONDS"] = "0.05"
+    kv = RendezvousServer(verbose=0)
+    kv_port = kv.start()
+    cdir = tempfile.mkdtemp(prefix="hvd-serve-ckpt-")
+    env["BENCH_SERVE_KV"] = "127.0.0.1:%d" % kv_port
+    env["BENCH_SERVE_CKPT_DIR"] = cdir
+    cfg = dlrm_tiny_config()
+    replica = None
+    try:
+        procs = _spawn_benchjson_workers(_SERVE_TRAINER_SRC, nproc,
+                                         extra_env=env)
+        # Bootstrap blocks on the FIRST committed manifest: serving
+        # starts as soon as the trainer publishes, not after it exits.
+        replica = ServingReplica(cdir)
+        deadline = time.perf_counter() + 180.0
+        while True:
+            try:
+                replica.bootstrap()
+                break
+            except Exception:
+                if (time.perf_counter() > deadline
+                        or any(p.poll() not in (None, 0)
+                               for p in procs)):
+                    raise
+                time.sleep(0.05)
+        replica.start()
+
+        rng = np.random.default_rng(17)
+        tables = ["dlrm.t%d" % i for i in range(cfg.num_tables)]
+        lat_ms, fresh_steps, fresh_secs = [], [], []
+        samples = []          # (step, table, ids, rows) for the gate
+        period = 1.0 / max(qps, 1.0)
+        t_begin = time.perf_counter()
+        n_queries = 0
+        while any(p.poll() is None for p in procs):
+            t_next = t_begin + n_queries * period
+            now = time.perf_counter()
+            if now < t_next:
+                time.sleep(min(t_next - now, period))
+            ids = ((rng.zipf(1.3, size=16) - 1)
+                   % cfg.table_rows[0]).astype(np.int64)
+            table = tables[n_queries % len(tables)]
+            t0 = time.perf_counter()
+            rows, step = replica.lookup(table, ids)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            served, latest = replica.freshness()
+            fresh_steps.append(max((latest or served) - served, 0))
+            g = _hm.snapshot()["gauges"].get(
+                "hvd_serve_freshness_seconds")
+            if g is not None:
+                fresh_secs.append(float(g))
+            if n_queries % 7 == 0 and len(samples) < 64:
+                samples.append((step, table, ids.copy(), rows.copy()))
+            n_queries += 1
+        wall = time.perf_counter() - t_begin
+        data = _drain_benchjson_workers(procs, timeout=900)
+        if "error" in data:
+            return data
+        replica.stop()
+
+        # Bit-consistency gate: replay each sampled step's committed
+        # chain through a FRESH read-only manager and compare the
+        # served rows against the assembled table at that step.
+        from horovod_tpu.checkpoint import CheckpointManager
+        ro = CheckpointManager(cdir, rank=0, world_size=1, keep=None)
+        assembled = {}
+        mismatches = 0
+        for step, table, ids, rows in samples:
+            key = (step, table)
+            if key not in assembled:
+                items = ro.restore(step)
+                assembled[key] = assemble_table(
+                    items, "sparse/%s/rows" % table)
+            if not np.array_equal(assembled[key][ids], rows):
+                mismatches += 1
+        ro.close()
+
+        def _pct(xs, q):
+            return round(float(np.percentile(xs, q)), 3) if xs else None
+
+        data["platform"] = "cpu"
+        data["query"] = {
+            "target_qps": qps,
+            "achieved_qps": round(n_queries / wall, 1) if wall else 0,
+            "queries": n_queries,
+            "read_p50_ms": _pct(lat_ms, 50),
+            "read_p99_ms": _pct(lat_ms, 99),
+            "freshness_steps_p50": _pct(fresh_steps, 50),
+            "freshness_steps_p99": _pct(fresh_steps, 99),
+            "freshness_seconds_p50": _pct(fresh_secs, 50),
+            "freshness_seconds_p99": _pct(fresh_secs, 99),
+        }
+        data["bit_consistency"] = {
+            "verified": len(samples),
+            "mismatches": mismatches,
+            "ok": bool(samples) and mismatches == 0,
+        }
+        snap = _hm.snapshot()
+        data["serve_metrics"] = {
+            "rows_total": snap["counters"].get("hvd_serve_rows_total"),
+            "snapshot_flips_total":
+                snap["counters"].get("hvd_serve_snapshot_flips_total"),
+        }
+        return data
+    finally:
+        if replica is not None:
+            replica.stop()
+        kv.stop()
+        shutil.rmtree(cdir, ignore_errors=True)
+
+
+def _load_prior_serve(repo_dir: str):
+    """Prior round's serve-lane read p99 (same artifact walk as the
+    other lanes; older rounds predate the lane and simply miss)."""
+    import glob
+    arts = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                data = json.loads(f.read())
+        except (OSError, ValueError):
+            continue
+        candidates = []
+        if isinstance(data, dict):
+            if isinstance(data.get("parsed"), dict):
+                candidates.append(data["parsed"])
+            candidates.append(data)
+        for d in candidates:
+            q = ((d.get("serve") or {}).get("query")
+                 if isinstance(d.get("serve"), dict) else None)
+            if isinstance(q, dict) and q.get("read_p99_ms"):
+                return {"read_p99_ms": float(q["read_p99_ms"]),
+                        "source": os.path.basename(path)}
+    return None
+
+
+def check_serve_regression(out: dict, repo_dir: str):
+    """Warn when the serving lane's read p99 regresses >2x vs the
+    prior round, and FAIL LOUDLY (stderr warning, recorded flag) when
+    the bit-consistency gate caught a torn or stale-row read — that is
+    the lane's whole reason to exist."""
+    cur = out.get("serve") or {}
+    gate = cur.get("bit_consistency") or {}
+    cmp = {"bit_consistency_ok": gate.get("ok")}
+    if gate and not gate.get("ok"):
+        print("WARNING: serve lane bit-consistency gate FAILED: "
+              "%s mismatches out of %s verified served reads"
+              % (gate.get("mismatches"), gate.get("verified")),
+              file=sys.stderr)
+    p99 = (cur.get("query") or {}).get("read_p99_ms")
+    prior = _load_prior_serve(repo_dir)
+    if p99 and prior is not None and prior["read_p99_ms"]:
+        ratio = p99 / prior["read_p99_ms"]
+        cmp.update({"read_p99_ms": p99,
+                    "prior_read_p99_ms": prior["read_p99_ms"],
+                    "prior_source": prior["source"],
+                    "ratio": round(ratio, 2),
+                    "regressed": ratio > 2.0})
+        if cmp["regressed"]:
+            print("WARNING: serve lane read p99 regressed %.1fx vs "
+                  "%s (%.2f -> %.2f ms)" % (
+                      ratio, prior["source"], prior["read_p99_ms"],
+                      p99), file=sys.stderr)
+    out["serve_vs_prior"] = cmp
 
 
 LAST_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2409,7 +2784,7 @@ def main():
                         "collectives", "checkpoint", "scale",
                         "recovery", "autoscale", "dlrm",
                         "coordscale", "blackbox", "tune",
-                        "straggler"],
+                        "straggler", "serve"],
                    default=None)
     args = p.parse_args()
 
@@ -2465,7 +2840,7 @@ def main():
                                      "collectives", "checkpoint",
                                      "scale", "recovery", "autoscale",
                                      "dlrm", "coordscale", "blackbox",
-                                     "tune", "straggler"}
+                                     "tune", "straggler", "serve"}
 
     resnet = {}
     if "resnet" in run:
@@ -2569,6 +2944,13 @@ def main():
         except Exception as e:
             out["straggler"] = {"error": repr(e)[:300]}
         check_straggler_regression(
+            out, os.path.dirname(os.path.abspath(__file__)))
+    if "serve" in run:
+        try:
+            out["serve"] = bench_serve(args, args.smoke)
+        except Exception as e:
+            out["serve"] = {"error": repr(e)[:300]}
+        check_serve_regression(
             out, os.path.dirname(os.path.abspath(__file__)))
 
     if args.smoke:

@@ -42,6 +42,7 @@ from .ops import (Handle, allgather, allgather_async, allreduce,
                   reducescatter_async, synchronize)
 
 from . import parallel
+from . import serve
 from . import sparse
 
 __all__ = [
@@ -67,5 +68,5 @@ __all__ = [
     # exceptions
     "HorovodInternalError", "HostsUpdatedInterrupt",
     # subpackages
-    "parallel", "sparse",
+    "parallel", "serve", "sparse",
 ]

@@ -508,6 +508,20 @@ class CheckpointManager:
         _BYTES.inc(nbytes, direction="read")
         return items
 
+    def step_items(self, step: int) -> Tuple[Dict[str, object],
+                                             Optional[int]]:
+        """One committed step's OWN items (no chain replay) plus its
+        ``delta_of`` parent (None for a full base) — the incremental
+        read the serving replica tails with: when the parent equals the
+        step a replica already serves, the RowDelta items here are
+        exactly the rows that changed.  Verifies every shard against
+        the manifest; raises like :meth:`restore` on corruption."""
+        sdir = _mf.step_dir(self.directory, step)
+        man = _mf.read_manifest(sdir)
+        parent = (man.meta or {}).get("delta_of")
+        return (self._read_step_items(step),
+                None if parent is None else int(parent))
+
     def restore(self, step: int) -> Dict[str, object]:
         """Restore one step, verifying every shard against its
         manifest.  A differential step replays its whole chain, base

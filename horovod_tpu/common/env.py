@@ -162,6 +162,47 @@ def ckpt_delta_chain_max() -> int:
                           CKPT_DELTA_CHAIN_MAX_DEFAULT))
 
 
+# --- sparse lookup plane --------------------------------------------------
+# Dedupe repeated ids within a batch before the ids alltoall in
+# ShardedEmbedding.lookup (each unique id crosses the wire once; rows
+# scatter back through the inverse index).  On Zipf-shaped traffic this
+# cuts alltoall bytes hard; 0 disables for A/B measurement.
+HOROVOD_SPARSE_DEDUPE = "HOROVOD_SPARSE_DEDUPE"
+
+
+def sparse_dedupe_enabled() -> bool:
+    """Whether lookup dedupes ids before the exchange, parsed freshly
+    per lookup (the bytes-comparison test flips it between passes)."""
+    return env_bool(HOROVOD_SPARSE_DEDUPE, True)
+
+
+# --- online serving plane (horovod_tpu/serve/) ----------------------------
+# Staleness bound for serving reads: reject a lookup when the freshest
+# committed training step is more than this many steps ahead of the
+# snapshot the replica is serving.  0 = unbounded (never reject).
+HOROVOD_SERVE_MAX_STALENESS_STEPS = "HOROVOD_SERVE_MAX_STALENESS_STEPS"
+SERVE_MAX_STALENESS_STEPS_DEFAULT = 0
+# How often the replica's tail thread polls the checkpoint directory
+# for newly committed manifests (seconds).
+HOROVOD_SERVE_POLL_SECONDS = "HOROVOD_SERVE_POLL_SECONDS"
+SERVE_POLL_SECONDS_DEFAULT = 0.5
+# Port for the HTTP lookup endpoint (0 = ephemeral).
+HOROVOD_SERVE_PORT = "HOROVOD_SERVE_PORT"
+
+
+def serve_max_staleness_steps() -> int:
+    """The staleness-rejection bound in steps (0 = unbounded), parsed
+    freshly per lookup so tests and operators can tighten it live."""
+    return max(0, env_int(HOROVOD_SERVE_MAX_STALENESS_STEPS,
+                          SERVE_MAX_STALENESS_STEPS_DEFAULT))
+
+
+def serve_poll_seconds() -> float:
+    """The manifest-tail poll interval in seconds."""
+    return max(0.01, env_float(HOROVOD_SERVE_POLL_SECONDS,
+                               SERVE_POLL_SECONDS_DEFAULT))
+
+
 def start_timeout(default: float = None) -> float:
     """The HOROVOD_START_TIMEOUT deadline (seconds), parsed freshly on
     every call so tests and elastic re-inits that mutate the env see

@@ -99,6 +99,12 @@ REPLAY = "replay"            # phase=enter/exit, reason?, batches?
 TUNE = "tune"                # phase=search/propose/frozen/aborted
 # Checkpoint
 CKPT = "ckpt"                # phase, step, outcome?
+# Online serving plane (horovod_tpu/serve): snapshot flips — every
+# atomic swap of the served snapshot records WHICH committed step went
+# live and how (bootstrap / incremental delta apply / full rebase), so
+# a postmortem can line the read path's freshness up against the
+# trainer's commit timeline.
+SERVE = "serve"              # phase=flip, step, mode, tables?
 # Elastic
 ELASTIC = "elastic"          # event, epoch?, rank?
 # Closed-loop elasticity (runner/elastic/policy.py): typed resize

@@ -1,0 +1,386 @@
+"""Snapshot-consistent serving replica over committed checkpoints.
+
+The replica turns the durable training artifact — committed manifests
+plus RowDelta chains (``horovod_tpu/checkpoint/``) — into an online,
+read-only embedding lookup plane, the trained-to-served pipeline of
+Check-N-Run (Eisenman et al., NSDI '22).  The committed MANIFEST is
+the one consistency boundary the trainer already guarantees
+(all-or-nothing, arbiter-published), so the replica reuses it as the
+read-side snapshot boundary, the same capture/persist split CheckFreq
+(Mohan et al., FAST '21) draws on the write side:
+
+* **Bootstrap** — ``restore_latest`` replays full base + delta chain
+  (falling back past corrupt steps exactly like a restarted trainer
+  would) and assembles every ``sparse/<table>/rows`` prefix into a
+  dense in-memory table.
+
+* **Tail** — a poll thread watches ``committed_steps()``; each newly
+  committed step whose ``delta_of`` is the step currently served is
+  applied *incrementally* (only the touched rows cross from disk), any
+  other gap (missed steps, resize, corrupt link) triggers a full
+  rebase through ``restore``.
+
+* **Atomic flip** — every advance builds a fresh immutable
+  :class:`_Snapshot` (copy-on-write per affected table) and installs
+  it with ONE reference assignment.  Readers grab ``self._snap`` once
+  per request, so a read observes exactly one committed training step
+  — a torn mid-apply view is structurally impossible, not just
+  locked away.  The ``serve.delta_apply`` failpoint sits BETWEEN build
+  and flip so the chaos drills can kill a replica at the worst moment
+  and assert reads before/after both see whole committed steps.
+
+* **Freshness plane** — ``hvd_serve_freshness_steps`` / ``_seconds``
+  gauges (freshest committed step minus served step), per-request
+  served-step stamping, and staleness-bound rejection
+  (``HOROVOD_SERVE_MAX_STALENESS_STEPS``): a replica that fell too far
+  behind starts refusing reads rather than silently serving stale
+  rows.
+
+See docs/serving.md for the architecture and freshness semantics.
+"""
+
+import logging
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..checkpoint import CheckpointManager, RowDelta
+from ..checkpoint.delta import assemble_table
+from ..common import env as _env
+from ..common import failpoints as _fp
+from ..common import flight_recorder as _fr
+from ..common import metrics
+
+logger = logging.getLogger("horovod_tpu.serve")
+
+_FRESH_STEPS = metrics.gauge(
+    "hvd_serve_freshness_steps",
+    "Freshest committed training step minus the step the replica "
+    "currently serves (0 = fully caught up)")
+_FRESH_SECONDS = metrics.gauge(
+    "hvd_serve_freshness_seconds",
+    "Wall seconds the replica has been behind the freshest committed "
+    "step (0 while caught up)")
+_LOOKUP_SECONDS = metrics.histogram(
+    "hvd_serve_lookup_seconds",
+    "Serving read latency by op (lookup = raw rows, bag = pooled "
+    "EmbeddingBag read)")
+_ROWS = metrics.counter(
+    "hvd_serve_rows_total",
+    "Rows served, split by whether the row was last written by the "
+    "bootstrap/rebase base image or an incrementally applied delta")
+_FLIPS = metrics.counter(
+    "hvd_serve_snapshot_flips_total",
+    "Atomic snapshot installs by kind (bootstrap / delta / rebase)")
+_REJECTS = metrics.counter(
+    "hvd_serve_rejects_total",
+    "Reads refused, by reason (staleness = freshness lag exceeded "
+    "HOROVOD_SERVE_MAX_STALENESS_STEPS)")
+
+# Sparse-table checkpoint items are named sparse/<table>/rows.r<rank>
+# (ShardedEmbedding.item_name); the prefix is the per-table assembly
+# key shared with assemble_table.
+_ITEM_RE = re.compile(r"^sparse/(.+)/rows\.r\d+$")
+
+
+class StalenessError(RuntimeError):
+    """Read refused: the replica is farther behind the freshest
+    committed step than HOROVOD_SERVE_MAX_STALENESS_STEPS allows."""
+
+
+class _Snapshot:
+    """One immutable served view: exactly one committed step's tables.
+
+    ``delta_mask[name][row]`` is True when the row's current value was
+    written by an incremental delta apply (vs the base image this
+    snapshot line descends from) — the source attribution behind
+    ``hvd_serve_rows_total{source=base|delta}``.
+    """
+
+    __slots__ = ("step", "tables", "delta_mask")
+
+    def __init__(self, step: int, tables: Dict[str, np.ndarray],
+                 delta_mask: Dict[str, np.ndarray]):
+        self.step = step
+        self.tables = tables
+        self.delta_mask = delta_mask
+
+
+def _full_snapshot(step: int, items: Dict[str, object]) -> "_Snapshot":
+    """A from-scratch snapshot: every sparse table assembled to full
+    coverage, delta masks cleared (everything is 'base' again)."""
+    tables: Dict[str, np.ndarray] = {}
+    masks: Dict[str, np.ndarray] = {}
+    for name in _split_by_table(items):
+        table = assemble_table(items, "sparse/%s/rows" % name)
+        if table is None:  # pragma: no cover - split guarantees a hit
+            continue
+        tables[name] = table
+        masks[name] = np.zeros(table.shape[0], dtype=bool)
+    return _Snapshot(step, tables, masks)
+
+
+def _split_by_table(items: Dict[str, object]) -> Dict[str, Dict[str, object]]:
+    """Group checkpoint items by embedding-table name, dropping
+    anything that is not a sparse-table shard (dense replicated state
+    has no read-side meaning here)."""
+    by_table: Dict[str, Dict[str, object]] = {}
+    for name, item in items.items():
+        m = _ITEM_RE.match(name)
+        if m is not None:
+            by_table.setdefault(m.group(1), {})[name] = item
+    return by_table
+
+
+class ServingReplica:
+    """Read-only embedding server over a trainer's checkpoint
+    directory.  All reads are lock-free against a single immutable
+    snapshot reference; only the tail thread (or explicit
+    ``poll_once`` calls) installs new snapshots."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        # Read-only manager: world_size=1 needs no coordinator, and
+        # keep=None means this replica never garbage-collects the
+        # trainer's steps out from under it.
+        self._mgr = CheckpointManager(directory, rank=0, world_size=1,
+                                      keep=None)
+        self._snap: Optional[_Snapshot] = None
+        self._latest_known: Optional[int] = None
+        self._behind_since: Optional[float] = None
+        self._poll_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # snapshot lifecycle (tail side)
+    # ------------------------------------------------------------------
+    def bootstrap(self) -> int:
+        """Load the newest valid committed step (full base + delta
+        chain, falling back past corrupt steps) and install it as the
+        first served snapshot.  Returns the served step.  Raises
+        :class:`~..checkpoint.CheckpointNotFoundError` when nothing
+        has ever been committed."""
+        step, items = self._mgr.restore_latest()
+        self._install(_full_snapshot(step, items), "bootstrap")
+        with self._poll_lock:
+            self._refresh_freshness()
+        return step
+
+    def poll_once(self) -> int:
+        """Tail newly committed steps: apply every step the trainer
+        committed since the served one (incremental delta apply when
+        the chain lines up, full rebase otherwise), refresh the
+        freshness gauges, and return how many snapshots were
+        installed.  A corrupt step is skipped — the replica keeps
+        serving the last good snapshot and never regresses."""
+        with self._poll_lock:
+            advanced = 0
+            snap = self._snap
+            if snap is None:
+                raise RuntimeError("poll_once before bootstrap")
+            for step in self._mgr.committed_steps():
+                if step <= self._snap.step:
+                    continue
+                try:
+                    if self._try_advance(step):
+                        advanced += 1
+                except Exception as e:  # corrupt link, torn disk, ...
+                    logger.warning(
+                        "serve: cannot advance to committed step %d "
+                        "(%s); still serving step %d", step, e,
+                        self._snap.step)
+            self._refresh_freshness()
+            return advanced
+
+    def _try_advance(self, step: int) -> bool:
+        """Build the snapshot for one newly committed ``step`` and
+        atomically install it.  Returns False when a failpoint dropped
+        the flip (the old snapshot stays live — the torn-apply drill's
+        'kill between build and install' window)."""
+        snap = self._snap
+        items, parent = self._mgr.step_items(step)
+        new = mode = None
+        if parent is not None and parent == snap.step:
+            try:
+                new = self._apply_delta(snap, step, items)
+                mode = "delta"
+            except KeyError:
+                pass  # table unknown to this snapshot line: rebase
+        elif parent is None:
+            new = _full_snapshot(step, items)
+            mode = "rebase"
+        if new is None:
+            # The step's own items do not extend what we serve (missed
+            # steps, a resize, a new table) — replay its whole
+            # base→tip chain.
+            new = _full_snapshot(step, self._mgr.restore(step))
+            mode = "rebase"
+        # The torn-apply window: the new snapshot exists but is NOT
+        # yet visible.  A crash here must leave readers on the old
+        # whole-step view; "drop" models a flip that never lands.
+        if _fp.ENABLED:
+            if _fp.maybe_fail("serve.delta_apply") == "drop":
+                return False
+        self._install(new, mode)
+        return True
+
+    @staticmethod
+    def _apply_delta(snap: "_Snapshot", step: int,
+                     items: Dict[str, object]) -> "_Snapshot":
+        """Copy-on-write application of one committed step's RowDelta
+        items on top of ``snap``: only tables the step touched are
+        copied, untouched tables are shared by reference (immutable by
+        convention — readers never write)."""
+        tables = dict(snap.tables)
+        masks = dict(snap.delta_mask)
+        for name, shard_items in _split_by_table(items).items():
+            base = tables.get(name)
+            deltas = [it for it in shard_items.values()
+                      if isinstance(it, RowDelta)]
+            if base is None:
+                # A table born after bootstrap: its delta carries all
+                # its touched rows, but without a base image the only
+                # safe view is a full assembly next rebase; skip.
+                logger.warning("serve: step %d touches unknown table "
+                               "%r; rebase required", step, name)
+                raise KeyError(name)
+            table = base.copy()
+            mask = masks[name].copy()
+            for d in deltas:
+                d.apply_to(table)
+                mask[d.rows] = True
+            tables[name] = table
+            masks[name] = mask
+        return _Snapshot(step, tables, masks)
+
+    def _install(self, snap: "_Snapshot", mode: str):
+        self._snap = snap  # THE atomic flip: one reference assignment
+        _FLIPS.inc(kind=mode)
+        if _fr.ENABLED:
+            _fr.record(_fr.SERVE, phase="flip", step=snap.step,
+                       mode=mode, tables=len(snap.tables))
+
+    def _refresh_freshness(self):
+        """Update the freshness gauges (called with _poll_lock
+        held)."""
+        steps = self._mgr.committed_steps()
+        latest = steps[-1] if steps else None
+        self._latest_known = latest
+        snap = self._snap
+        if snap is None or latest is None:
+            return
+        lag = max(0, latest - snap.step)
+        _FRESH_STEPS.set(float(lag))
+        if lag == 0:
+            self._behind_since = None
+            _FRESH_SECONDS.set(0.0)
+        else:
+            now = time.monotonic()
+            if self._behind_since is None:
+                self._behind_since = now
+            _FRESH_SECONDS.set(now - self._behind_since)
+
+    # ------------------------------------------------------------------
+    # tail thread
+    # ------------------------------------------------------------------
+    def start(self):
+        """Start the background tail thread (bootstrap must have
+        happened)."""
+        if self._snap is None:
+            raise RuntimeError("start before bootstrap")
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._tail_loop,
+                                        name="hvd-serve-tail",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def _tail_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                # Serving must outlive any tail hiccup (trainer mid-
+                # write, directory briefly unreadable, ...).
+                logger.exception("serve: poll failed; still serving")
+            self._stop.wait(_env.serve_poll_seconds())
+
+    # ------------------------------------------------------------------
+    # read side (lock-free)
+    # ------------------------------------------------------------------
+    def freshness(self) -> Tuple[int, Optional[int]]:
+        """(served step, freshest known committed step)."""
+        snap = self._snap
+        if snap is None:
+            raise RuntimeError("freshness before bootstrap")
+        return snap.step, self._latest_known
+
+    def table_names(self) -> List[str]:
+        snap = self._snap
+        return sorted(snap.tables) if snap is not None else []
+
+    def _check_staleness(self, snap: "_Snapshot"):
+        bound = _env.serve_max_staleness_steps()
+        if not bound:
+            return
+        latest = self._latest_known
+        lag = 0 if latest is None else max(0, latest - snap.step)
+        if lag > bound:
+            _REJECTS.inc(reason="staleness")
+            raise StalenessError(
+                "replica serves step %d but step %d is committed "
+                "(lag %d > bound %d)" % (snap.step, latest, lag, bound))
+
+    def lookup(self, table: str, ids) -> Tuple[np.ndarray, int]:
+        """Batch id lookup against the current snapshot.  Returns
+        ``(rows, served_step)`` — the step stamp is the consistency
+        contract: every returned row is the committed value at exactly
+        that training step.  Raises KeyError (unknown table),
+        IndexError (id out of range), :class:`StalenessError`."""
+        t0 = time.perf_counter()
+        snap = self._snap
+        if snap is None:
+            raise RuntimeError("lookup before bootstrap")
+        self._check_staleness(snap)
+        arr = snap.tables[table]
+        ids = np.asarray(ids, np.int64)
+        rows = arr[ids]  # fancy index: a copy, detached from the snap
+        n_delta = int(np.count_nonzero(snap.delta_mask[table][ids]))
+        if n_delta:
+            _ROWS.inc(float(n_delta), source="delta")
+        if len(ids) - n_delta:
+            _ROWS.inc(float(len(ids) - n_delta), source="base")
+        _LOOKUP_SECONDS.observe(time.perf_counter() - t0, op="lookup")
+        return rows, snap.step
+
+    def embedding_bag(self, table: str, ids, offsets,
+                      mode: str = "sum") -> Tuple[np.ndarray, int]:
+        """Pooled EmbeddingBag read (the DLRM bag shape, torch offsets
+        convention: example i owns ids[offsets[i]:offsets[i+1]]).
+        Returns ``(pooled, served_step)``."""
+        if mode not in ("sum", "mean"):
+            raise ValueError("mode must be 'sum' or 'mean'")
+        t0 = time.perf_counter()
+        rows, step = self.lookup(table, ids)
+        offsets = np.asarray(offsets, np.int64)
+        sizes = np.diff(np.concatenate([offsets, [rows.shape[0]]]))
+        if (sizes < 0).any():
+            raise ValueError("offsets must be non-decreasing")
+        seg = np.repeat(np.arange(len(offsets)), sizes)
+        out = np.zeros((len(offsets), rows.shape[1]), rows.dtype)
+        np.add.at(out, seg, rows)
+        if mode == "mean":
+            out /= np.maximum(sizes, 1)[:, None]
+        _LOOKUP_SECONDS.observe(time.perf_counter() - t0, op="bag")
+        return out, step

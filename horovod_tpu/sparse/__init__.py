@@ -32,6 +32,7 @@ See docs/sparse_embedding.md for the exchange protocol and
 models/dlrm.py + bench.py (``--only dlrm``) for the workload.
 """
 
-from .embedding import EmbeddingBag, ShardedEmbedding
+from .embedding import (EmbeddingBag, ShardedEmbedding,
+                        lookup_overlapped)
 
-__all__ = ["ShardedEmbedding", "EmbeddingBag"]
+__all__ = ["ShardedEmbedding", "EmbeddingBag", "lookup_overlapped"]

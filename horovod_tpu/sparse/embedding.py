@@ -35,10 +35,11 @@ rows, both stay marked so the next delta still carries them.
 import logging
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import env as _env
 from ..common import metrics
 from ..checkpoint.delta import RowDelta, assemble_table
 
@@ -69,18 +70,51 @@ def _alltoall(tensor: np.ndarray, splits: np.ndarray, name: str
 
 
 class _LookupContext:
-    """Routing state one lookup leaves behind for its backward."""
+    """Routing state one lookup leaves behind for its backward.
+
+    With dedupe (``HOROVOD_SPARSE_DEDUPE``, the default) the exchange
+    runs over the batch's UNIQUE ids; ``inv`` is the inverse index
+    scattering unique rows back to input order, and the backward
+    accumulates duplicate-id gradients through it before routing.
+    ``inv is None`` means the exchange carried the raw batch.
+    """
 
     __slots__ = ("perm", "send_counts", "recv_splits", "recv_slots",
-                 "n_ids")
+                 "n_ids", "inv", "n_unique")
 
     def __init__(self, perm, send_counts, recv_splits, recv_slots,
-                 n_ids):
+                 n_ids, inv=None, n_unique=None):
         self.perm = perm
         self.send_counts = send_counts
         self.recv_splits = recv_splits
         self.recv_slots = recv_slots
         self.n_ids = n_ids
+        self.inv = inv
+        self.n_unique = n_unique if n_unique is not None else n_ids
+
+
+class _PendingLookup:
+    """In-flight state of one table's staged lookup (the overlapped
+    multi-table path drives several of these concurrently)."""
+
+    __slots__ = ("table", "t0", "ids", "ex_ids", "inv", "call",
+                 "perm", "send_ids", "send_counts", "handle",
+                 "recv_splits", "recv_slots", "out")
+
+    def __init__(self, table, t0, ids, ex_ids, inv, call):
+        self.table = table
+        self.t0 = t0
+        self.ids = ids
+        self.ex_ids = ex_ids
+        self.inv = inv
+        self.call = call
+        self.perm = None
+        self.send_ids = None
+        self.send_counts = None
+        self.handle = None
+        self.recv_splits = None
+        self.recv_slots = None
+        self.out = None
 
 
 class ShardedEmbedding:
@@ -191,44 +225,88 @@ class ShardedEmbedding:
         exchange; returns ``(len(ids), dim)`` in input order.  EVERY
         rank must call lookup for the same table in the same step
         (splits may differ — that is the point), like any collective.
+
+        With ``HOROVOD_SPARSE_DEDUPE`` (default on) only the batch's
+        UNIQUE ids cross the wire — on Zipf-shaped traffic repeated
+        hot ids dominate, so the ids/rows/grads payloads all shrink —
+        and rows scatter back through the inverse index.  The staged
+        helpers below are shared with :func:`lookup_overlapped`, which
+        keeps several tables' exchanges in flight together.
         """
+        p = self._lookup_start(ids)
+        if self.size == 1:
+            return self._lookup_finish_local(p)
+        self._lookup_route(p)
+        recv_ids, recv_splits = _alltoall(
+            p.send_ids, p.send_counts,
+            name="sparse.%s.ids.%d" % (self.name, p.call))
+        served = self._lookup_serve(p, recv_ids, recv_splits)
+        rows, _ = _alltoall(
+            served, p.recv_splits,
+            name="sparse.%s.rows.%d" % (self.name, p.call))
+        return self._lookup_finish(p, rows)
+
+    # --- staged lookup internals (shared by lookup_overlapped) --------
+    def _lookup_start(self, ids) -> _PendingLookup:
+        """Local prep: validate, dedupe (when enabled), claim a call
+        number."""
         t0 = time.perf_counter()
         ids = np.ascontiguousarray(np.asarray(ids, np.int64))
         self._check_ids(ids)
-        call = self._next_call()
-        if self.size == 1:
-            slots = self.slot_of(ids)
-            self._ctx = _LookupContext(None, None, None, slots,
-                                       len(ids))
-            out = self.local[slots].copy()
-            _LOOKUP_SECONDS.observe(
-                time.perf_counter() - t0, op="lookup")
-            return out
-        owners = self.owner_of(ids)
-        perm = np.argsort(owners, kind="stable")
-        send_ids = ids[perm]
-        send_counts = np.bincount(owners, minlength=self.size
-                                  ).astype(np.int64)
-        recv_ids, recv_splits = _alltoall(
-            send_ids, send_counts,
-            name="sparse.%s.ids.%d" % (self.name, call))
+        if _env.sparse_dedupe_enabled():
+            ex_ids, inv = np.unique(ids, return_inverse=True)
+            ex_ids = np.ascontiguousarray(ex_ids)
+        else:
+            ex_ids, inv = ids, None
+        return _PendingLookup(self, t0, ids, ex_ids, inv,
+                              self._next_call())
+
+    def _lookup_finish_local(self, p: "_PendingLookup") -> np.ndarray:
+        slots = self.slot_of(p.ex_ids)
+        self._ctx = _LookupContext(None, None, None, slots,
+                                   len(p.ids), inv=p.inv,
+                                   n_unique=len(p.ex_ids))
+        gathered = self.local[slots]         # fancy index: a copy
+        out = gathered if p.inv is None else gathered[p.inv]
+        _LOOKUP_SECONDS.observe(
+            time.perf_counter() - p.t0, op="lookup")
+        return out
+
+    def _lookup_route(self, p: "_PendingLookup"):
+        """Compute the owner-sorted send layout for the ids
+        exchange."""
+        owners = self.owner_of(p.ex_ids)
+        p.perm = np.argsort(owners, kind="stable")
+        p.send_ids = np.ascontiguousarray(p.ex_ids[p.perm])
+        p.send_counts = np.bincount(owners, minlength=self.size
+                                    ).astype(np.int64)
+
+    def _lookup_serve(self, p: "_PendingLookup", recv_ids,
+                      recv_splits) -> np.ndarray:
+        """Serve the locally owned rows requested by peers (between
+        the ids and rows exchanges)."""
         _A2A_OPS.inc(1, stage="ids")
-        _A2A_BYTES.inc(int(send_ids.nbytes), stage="ids")
-        recv_slots = self.slot_of(recv_ids)
-        served = self.local[recv_slots]
-        rows, _ = _alltoall(
-            np.ascontiguousarray(served),
-            np.asarray(recv_splits, np.int64),
-            name="sparse.%s.rows.%d" % (self.name, call))
+        _A2A_BYTES.inc(int(p.send_ids.nbytes), stage="ids")
+        p.recv_splits = np.asarray(recv_splits, np.int64)
+        p.recv_slots = self.slot_of(np.asarray(recv_ids))
+        served = np.ascontiguousarray(self.local[p.recv_slots])
         _A2A_OPS.inc(1, stage="rows")
         _A2A_BYTES.inc(int(served.nbytes), stage="rows")
-        out = np.empty((len(ids), self.dim), self.dtype)
-        out[perm] = rows
-        self._ctx = _LookupContext(perm, send_counts,
-                                   np.asarray(recv_splits, np.int64),
-                                   recv_slots, len(ids))
+        return served
+
+    def _lookup_finish(self, p: "_PendingLookup",
+                       rows) -> np.ndarray:
+        """Scatter exchanged rows back to input order and park the
+        routing context for the backward."""
+        gathered = np.empty((len(p.ex_ids), self.dim), self.dtype)
+        gathered[p.perm] = rows
+        out = gathered if p.inv is None else gathered[p.inv]
+        self._ctx = _LookupContext(p.perm, p.send_counts,
+                                   p.recv_splits, p.recv_slots,
+                                   len(p.ids), inv=p.inv,
+                                   n_unique=len(p.ex_ids))
         _LOOKUP_SECONDS.observe(
-            time.perf_counter() - t0, op="lookup")
+            time.perf_counter() - p.t0, op="lookup")
         return out
 
     def apply_gradients(self, grad, lr: float = 0.01):
@@ -247,6 +325,14 @@ class ShardedEmbedding:
             raise ValueError(
                 "grad shape %s does not match last lookup (%d, %d)"
                 % (grad.shape, ctx.n_ids, self.dim))
+        if ctx.inv is not None:
+            # Deduped lookup: duplicate-id gradients accumulate into
+            # one row per unique id BEFORE the lr scaling and the
+            # exchange, in table dtype — so the wire carries (and the
+            # owner applies) one update per unique id per requester.
+            acc = np.zeros((ctx.n_unique, self.dim), self.dtype)
+            np.add.at(acc, ctx.inv, grad)
+            grad = acc
         if self.size == 1:
             grad_recv, recv_slots = grad, ctx.recv_slots
         else:
@@ -360,6 +446,56 @@ class ShardedEmbedding:
             raise RuntimeError(
                 "full_table() without items is single-rank only")
         return self.local.copy()
+
+
+def lookup_overlapped(tables: Sequence[ShardedEmbedding],
+                      ids_list: Sequence) -> List[np.ndarray]:
+    """Look up several tables with their alltoall exchanges in flight
+    TOGETHER: all ids exchanges are issued async first, each table's
+    rows are served and its rows exchange issued as its ids land, and
+    everything is gathered at the end — so table k's wire time hides
+    behind table j's serve/scatter work instead of serializing after
+    it (a DLRM step touches dozens of tables back to back).
+
+    Per table the staged math is byte-for-byte the code ``lookup``
+    runs (same helpers, same op order within a table), so results are
+    bit-identical to the serial path, and each table's backward
+    context is parked exactly as a plain lookup would — call
+    ``apply_gradients`` per table afterwards as usual.  Tables must be
+    distinct; every rank must call this with the same table list.
+    """
+    if len(tables) != len(ids_list):
+        raise ValueError("need one ids batch per table (%d vs %d)"
+                         % (len(tables), len(ids_list)))
+    if len(set(id(t) for t in tables)) != len(tables):
+        raise ValueError("tables must be distinct")
+    from ..ops import eager
+    pend = [t._lookup_start(ids)
+            for t, ids in zip(tables, ids_list)]
+    outs: List[Optional[np.ndarray]] = [None] * len(pend)
+    remote = []
+    for i, p in enumerate(pend):
+        if p.table.size == 1:
+            outs[i] = p.table._lookup_finish_local(p)
+        else:
+            p.table._lookup_route(p)
+            p.handle = eager.alltoall_async(
+                p.send_ids, splits=p.send_counts,
+                name="sparse.%s.ids.%d" % (p.table.name, p.call))
+            remote.append(i)
+    for i in remote:
+        p = pend[i]
+        recv_ids, recv_splits = eager.synchronize(p.handle)
+        served = p.table._lookup_serve(p, np.asarray(recv_ids),
+                                       np.asarray(recv_splits))
+        p.handle = eager.alltoall_async(
+            served, splits=p.recv_splits,
+            name="sparse.%s.rows.%d" % (p.table.name, p.call))
+    for i in remote:
+        p = pend[i]
+        rows, _ = eager.synchronize(p.handle)
+        outs[i] = p.table._lookup_finish(p, np.asarray(rows))
+    return outs
 
 
 class EmbeddingBag:

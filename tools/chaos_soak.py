@@ -1841,6 +1841,222 @@ def run_delta_chain_drill(ranks: int = 4, seed: int = 0,
 
 
 # ---------------------------------------------------------------------------
+# serve drill: trainer killed mid-commit, replica keeps answering
+# ---------------------------------------------------------------------------
+
+def run_serve_drill(ranks: int = 4, seed: int = 0, steps: int = 18,
+                    commit_every: int = 3, victim: int = None,
+                    commit_timeout_s: float = 3.0) -> dict:
+    """Trainer-kill serving drill (docs/serving.md): ``ranks``
+    thread-ranks train the closed-form sparse table and commit a
+    differential checkpoint every ``commit_every`` steps while a
+    :class:`horovod_tpu.serve.ServingReplica` in the MAIN thread tails
+    the same directory and answers full-table reads throughout.  The
+    victim dies INSIDE its delta shard write (``ckpt.delta_write``
+    crash failpoint), the in-flight commit never publishes, and the
+    whole world stops — the replica must keep answering from the last
+    committed step.  A restarted world resumes from ``restore_latest``
+    and commits to the end; the replica must resume tailing without a
+    restart of its own.  Every read in every phase is compared against
+    the closed-form table at its OWN served-step stamp — a single
+    torn, stale-stamped, or backwards read fails the drill."""
+    import shutil
+    import tempfile
+
+    from horovod_tpu.checkpoint import (CheckpointManager,
+                                        LocalCommitCoordinator,
+                                        RowDelta)
+    from horovod_tpu.checkpoint import manifest as _mf
+    from horovod_tpu.serve import ServingReplica
+
+    t0 = time.monotonic()
+    rng = random.Random("%d|serve-drill" % seed)
+    if victim is None:
+        victim = rng.randrange(1, ranks)
+    assert steps % commit_every == 0 and steps // commit_every >= 4
+    boundaries = list(range(commit_every, steps + 1, commit_every))
+    # Kill at the FOURTH boundary: the first is the full base, so the
+    # victim's crashing write is its third delta (after=2 skips the
+    # two healthy ones).  Default chain_max (8) keeps all of these on
+    # one chain.
+    kill_commit = boundaries[3]
+    failpoints.configure(
+        "ckpt.delta_write=crash(times=1,rank=%d,after=2)" % victim,
+        seed=seed)
+
+    def crash_handler(site):
+        raise SimCrash("injected crash at %s" % site)
+
+    failpoints.set_crash_handler(crash_handler)
+    ckpt_dir = tempfile.mkdtemp(prefix="hvd-serve-drill-")
+    old_poll = os.environ.get("HOROVOD_SERVE_POLL_SECONDS")
+    os.environ["HOROVOD_SERVE_POLL_SECONDS"] = "0.02"
+    errors = []
+
+    def world_phase(start: int, end: int, kill: int = None):
+        """One trainer incarnation: commit every boundary in
+        (start, end].  All state is closed-form, so a restarted world
+        resumes from the restored step with zero handoff."""
+        coord = LocalCommitCoordinator()
+        mgrs = [CheckpointManager(ckpt_dir, rank=r, world_size=ranks,
+                                  coordinator=coord, keep=None,
+                                  commit_timeout_s=commit_timeout_s)
+                for r in range(ranks)]
+
+        def rank_loop(rank: int):
+            own = [r for r in range(_DELTA_ROWS)
+                   if r % ranks == rank]
+            try:
+                for b in [b for b in boundaries if start < b <= end]:
+                    plan = mgrs[rank].delta_plan()
+                    if plan is None:
+                        rows = own
+                    else:
+                        win = set()
+                        for s in range(plan, b):
+                            win.update(_delta_touched_rows(s))
+                        rows = sorted(r for r in win
+                                      if r % ranks == rank)
+                    table = _delta_table_at(b)
+                    local = {"%s.r%05d" % (_DELTA_PREFIX, rank):
+                             RowDelta(np.array(rows, np.int64),
+                                      table[rows].copy(),
+                                      _DELTA_ROWS)}
+                    mgrs[rank].save_async(b, {"obj/step": b},
+                                          local_items=local,
+                                          delta_of=plan)
+                    mgrs[rank].wait(2 * commit_timeout_s + 10)
+                    if kill is not None and b == kill \
+                            and rank == victim:
+                        raise SimCrash("died mid-commit %d" % b)
+                    # Healthy publish is milliseconds; a commit that
+                    # has not published within the commit timeout is
+                    # starved by the victim's missing mark ("prepared"
+                    # IS terminal on non-arbiter ranks, so their own
+                    # outcome never flips) — the world dies with it.
+                    deadline = time.monotonic() \
+                        + commit_timeout_s + 1.0
+                    while coord.committed_step() != b \
+                            and time.monotonic() < deadline:
+                        if mgrs[rank].outcome(b) == "failed":
+                            raise SimCrash("commit %d starved" % b)
+                        time.sleep(0.004)
+                    if coord.committed_step() != b:
+                        raise SimCrash("commit %d never published"
+                                       % b)
+            except SimCrash:
+                mgrs[rank].abort()
+            except Exception as e:  # pragma: no cover - plumbing
+                errors.append("rank %d: %r" % (rank, e))
+
+        threads = [threading.Thread(target=rank_loop, args=(r,),
+                                    name="serve-drill-r%d" % r,
+                                    daemon=True)
+                   for r in range(ranks)]
+        for t in threads:
+            t.start()
+        return threads, mgrs
+
+    def drain_phase(threads, mgrs):
+        for t in threads:
+            t.join(timeout=60)
+            if t.is_alive():
+                errors.append("%s never exited" % t.name)
+        for m in mgrs:
+            m.wait(timeout=2 * commit_timeout_s + 5)
+            m.close(timeout=1.0)
+
+    reads = 0
+    violations = []
+    expected = {}
+    last_step = [None]
+
+    def read_and_check(rep):
+        """One full-table read, checked against the closed form at its
+        own step stamp; a backwards stamp is a violation too."""
+        nonlocal reads
+        rows, step = rep.lookup("tbl", np.arange(_DELTA_ROWS))
+        if step not in expected:
+            expected[step] = _delta_table_at(step)
+        if not np.array_equal(rows, expected[step]):
+            violations.append({"step": step, "kind": "torn"})
+        if last_step[0] is not None and step < last_step[0]:
+            violations.append({"step": step, "kind": "regressed",
+                               "from": last_step[0]})
+        last_step[0] = step
+        reads += 1
+        return step
+
+    record = {"kind": "serve_drill", "ranks": ranks, "seed": seed,
+              "victim": victim, "kill_commit": kill_commit,
+              "steps": steps, "commit_every": commit_every}
+    rep = None
+    try:
+        threads, mgrs = world_phase(0, steps, kill=kill_commit)
+        deadline = time.monotonic() + 30.0
+        while not _mf.committed_steps(ckpt_dir) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        rep = ServingReplica(ckpt_dir)
+        rep.bootstrap()
+        rep.start()
+        while any(t.is_alive() for t in threads):
+            read_and_check(rep)
+            time.sleep(0.003)
+        drain_phase(threads, mgrs)
+        committed_before = max(_mf.committed_steps(ckpt_dir))
+        record["committed_before_kill"] = committed_before
+        # The dead-trainer gap: the replica must settle on the last
+        # committed step and keep answering from it.
+        deadline = time.monotonic() + 10.0
+        while rep.freshness()[0] < committed_before \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        gap_step = read_and_check(rep)
+        record["served_during_gap"] = gap_step
+        gap_ok = gap_step == committed_before
+        # Restart: a new world resumes from the restored step and the
+        # replica tails straight through — no replica restart.
+        failpoints.reset()
+        threads, mgrs = world_phase(committed_before, steps)
+        while any(t.is_alive() for t in threads):
+            read_and_check(rep)
+            time.sleep(0.003)
+        drain_phase(threads, mgrs)
+        deadline = time.monotonic() + 10.0
+        while rep.freshness()[0] < steps \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        final_step = read_and_check(rep)
+        record.update({
+            "resumed_to": final_step,
+            "reads": reads,
+            "torn_reads": len(violations),
+            "violations": violations[:5],
+            "errors": errors,
+            "ok": (not errors and not violations and gap_ok
+                   and committed_before == kill_commit - commit_every
+                   and final_step == steps),
+        })
+    except Exception as e:
+        record.update({"ok": False, "error": repr(e)[:300],
+                       "errors": errors, "reads": reads,
+                       "torn_reads": len(violations)})
+    finally:
+        if rep is not None:
+            rep.stop()
+        failpoints.reset()
+        failpoints.set_crash_handler(None)
+        if old_poll is None:
+            os.environ.pop("HOROVOD_SERVE_POLL_SECONDS", None)
+        else:
+            os.environ["HOROVOD_SERVE_POLL_SECONDS"] = old_poll
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    record["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return record
+
+
+# ---------------------------------------------------------------------------
 # MTTR drill: detect -> restore -> resume, with a number on it
 # ---------------------------------------------------------------------------
 
@@ -3346,6 +3562,12 @@ def main(argv=None) -> int:
     parser.add_argument("--grow-to", type=int, default=None,
                         help="autoscale drill target size "
                              "(default: 2 * --ranks)")
+    parser.add_argument("--serve-drill", action="store_true",
+                        help="run the trainer-kill serving drill "
+                             "(replica keeps answering from the last "
+                             "committed step, resumes tailing after "
+                             "the restart) instead of the "
+                             "fault-schedule soak")
     parser.add_argument("--tune-drill", action="store_true",
                         help="run the autotune-then-freeze abort "
                              "drills (rank killed mid-search + "
@@ -3367,6 +3589,18 @@ def main(argv=None) -> int:
                 json.dump(report, f, indent=1)
         summary = {k: report.get(k) for k in
                    ("ranks", "grow_to", "autoscale_s", "ok",
+                    "elapsed_s")}
+        print("CHAOSJSON " + json.dumps(summary))
+        return 0 if report["ok"] else 1
+    if args.serve_drill:
+        report = run_serve_drill(ranks=args.ranks, seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        summary = {k: report.get(k) for k in
+                   ("ranks", "victim", "kill_commit",
+                    "committed_before_kill", "served_during_gap",
+                    "resumed_to", "reads", "torn_reads", "ok",
                     "elapsed_s")}
         print("CHAOSJSON " + json.dumps(summary))
         return 0 if report["ok"] else 1
